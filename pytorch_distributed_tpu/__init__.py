@@ -1,0 +1,25 @@
+"""pytorch_distributed_tpu — a TPU-native distributed RL framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+``LJP580230/pytorch-distributed`` repo (Ape-X style asynchronous actor/learner
+training with a global replay memory, Distributed DQN + Distributed DDPG,
+Atari pipeline, evaluator/tester/logger processes, TensorBoard metrics and
+checkpointing) — built TPU-first:
+
+- the learner update is a single jit-compiled XLA program, optionally
+  sharded over a ``jax.sharding.Mesh`` with gradient all-reduce over ICI
+  (``parallel/``);
+- the replay memory is either a host ring buffer shared across actor
+  processes (``memory/shared_replay.py``, the equivalent of the reference's
+  ``core/memories/shared_memory.py``) or a device-resident sharded buffer in
+  HBM (``memory/device_replay.py``);
+- models are Flax modules with explicitly-keyed functional ``act`` policies
+  (``models/``), replacing the reference's ``core/models/*`` torch modules;
+- actor/learner/evaluator/tester/logger are OS processes communicating by
+  explicit message passing instead of shared CUDA storage
+  (``agents/``, replacing the reference's ``core/single_processes/``).
+
+See SURVEY.md at the repo root for the layer-by-layer mapping.
+"""
+
+__version__ = "0.1.0"

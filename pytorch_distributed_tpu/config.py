@@ -1,0 +1,311 @@
+"""Configuration system.
+
+TPU-native re-design of the reference's config layer (``utils/options.py`` in
+the reference repo: the ``CONFIGS`` 5-tuple table at :10-14 and the
+``Params``/``EnvParams``/``MemoryParams``/``ModelParams``/``AgentParams``/
+``Options`` class hierarchy at :16-175).
+
+Differences from the reference, on purpose:
+
+- Plain frozen-by-convention dataclasses instead of mutually-inheriting
+  classes with class-attribute singletons; an ``Options`` instance is an
+  explicit value that is passed around (and pickled across process spawns).
+- A real CLI (``--config``, ``--mode``, ``--num-actors``, ...) in
+  ``main.py`` on top of the table — the reference is edit-the-file only
+  (reference ``README.md:41-49``).
+- Hyperparameter *values* mirror the reference defaults exactly
+  (reference ``utils/options.py:108-168``) so learning behaviour is
+  comparable; each is annotated with its reference source.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# The CONFIGS table: each row bundles compatible component choices, exactly
+# like reference utils/options.py:10-14 —
+#   [agent_type, env_type, game, memory_type, model_type]
+# Rows 0 is the reference's only row (dqn/atari/pong/shared/dqn-cnn).  The
+# extra rows cover the driver BASELINE.json tracked configs plus self-
+# contained debug/bench envs that need no ALE install.
+# ---------------------------------------------------------------------------
+CONFIGS = [
+    # agent_type, env_type,    game,          memory_type, model_type
+    ["dqn",       "atari",     "pong",        "shared",    "dqn-cnn"],   # 0 (reference row 0)
+    ["dqn",       "fake",      "chain",       "shared",    "dqn-mlp"],   # 1 smoke/debug
+    ["ddpg",      "classic",   "pendulum",    "shared",    "ddpg-mlp"],  # 2
+    ["dqn",       "classic",   "cartpole",    "shared",    "dqn-mlp"],   # 3
+    ["dqn",       "pong-sim",  "pong",        "shared",    "dqn-cnn"],   # 4 ALE-free Pong clone
+    ["dqn",       "atari",     "breakout",    "shared",    "dqn-cnn"],   # 5
+    ["dqn",       "pong-sim",  "pong",        "prioritized", "dqn-cnn"], # 6 PER
+    ["dqn",       "atari",     "pong",        "prioritized", "dqn-cnn"], # 7 PER on ALE
+]
+
+
+def _default_refs() -> str:
+    """Run signature ``{machine}_{timestamp}`` keying checkpoints and logs
+    (reference utils/options.py:37-51)."""
+    machine = os.uname().nodename.split(".")[0] or "machine"
+    return f"{machine}_{time.strftime('%y%m%d%H%M%S')}"
+
+
+@dataclass
+class EnvParams:
+    """Env-layer knobs (reference utils/options.py:54-69)."""
+
+    env_type: str = "atari"
+    game: str = "pong"
+    seed: int = 100
+    # State layout: ``state_cha`` is the history length (stacked frames for
+    # CNNs, 1 for MLPs); hei/wid are the per-frame spatial dims.
+    state_cha: int = 4
+    state_hei: int = 84
+    state_wid: int = 84
+    # Max emulator frames per episode before truncation
+    # (reference utils/options.py:69; "early_stop").
+    early_stop: int = 12500
+    # Life-loss-as-terminal & action-repeat semantics toggled by mode
+    # (reference core/env.py:29-35).
+    action_repetition: int = 4
+    # Vector-env width per actor process.  The reference asserts this to 1
+    # (utils/options.py:32, atari_env.py:15); here >1 is supported by the
+    # sim envs and batched inference.
+    num_envs_per_actor: int = 1
+    render: bool = False
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        if self.state_hei > 1 or self.state_cha > 1:
+            return (self.state_cha, self.state_hei, self.state_wid)
+        return (self.state_wid,)
+
+
+@dataclass
+class MemoryParams:
+    """Replay-memory knobs (reference utils/options.py:72-94)."""
+
+    memory_type: str = "shared"
+    memory_size: int = 50000           # reference utils/options.py:78-80
+    enable_per: bool = False           # reference leaves PER unfinished (":82 TODO")
+    # uint8 states for image observations, float32 for low-dim
+    # (reference utils/options.py:84-91).
+    state_dtype: str = "uint8"
+    # PER exponents (reference utils/options.py:92-94; Ape-X paper values).
+    priority_exponent: float = 0.6
+    priority_weight: float = 0.4
+    # Device-resident replay: shard the buffer across the learner mesh's
+    # data axis and sample on device (TPU-native addition; no reference
+    # equivalent — the reference buffer is host shared memory).
+    device_resident: bool = False
+
+
+@dataclass
+class ModelParams:
+    """Model knobs (reference utils/options.py:97-105 is empty; we add the
+    few things the models actually need)."""
+
+    model_type: str = "dqn-cnn"
+    hidden_dim: int = 256              # dqn-mlp width (reference dqn_mlp_model.py:18-26)
+    # Apply orthogonal init for the CNN.  The reference *defines* orthogonal
+    # init but never applies it (dqn_cnn_model.py:33 commented out) — here it
+    # is applied and this flag documents the deliberate divergence.
+    orthogonal_init: bool = True
+    # Compute dtype for the forward/backward pass on TPU (params stay fp32).
+    compute_dtype: str = "bfloat16"
+
+
+@dataclass
+class AgentParams:
+    """Algorithm + process-cadence hyperparameters.
+
+    DQN values mirror reference utils/options.py:112-141; DDPG values mirror
+    :142-168.  ``build_agent_params`` below selects per-family defaults.
+    """
+
+    agent_type: str = "dqn"
+    # --- generic (reference :117-127 / :146-156) ---
+    steps: int = 500000                # max learner steps
+    gamma: float = 0.99
+    clip_grad: float = float("inf")    # dqn: inf; ddpg: 40.0
+    lr: float = 1e-4
+    lr_decay: bool = False
+    weight_decay: float = 0.0
+    actor_sync_freq: int = 100         # dqn: 100; ddpg: 400
+    # --- logger cadences (reference :128-133 / :157-162) ---
+    logger_freq: int = 15              # secs
+    actor_freq: int = 250              # actor steps; ddpg: 2500
+    learner_freq: int = 100            # learner steps; ddpg: 1000
+    evaluator_freq: int = 30           # secs; ddpg: 60
+    evaluator_nepisodes: int = 2
+    tester_nepisodes: int = 50
+    # --- off-policy core (reference :134-137 / :163-166) ---
+    learn_start: int = 5000            # ddpg: 250
+    batch_size: int = 128              # ddpg: 64
+    target_model_update: float = 250   # >=1: hard every N steps; <1: soft tau
+    nstep: int = 5
+    # --- dqn specifics (reference :138-141) ---
+    enable_double: bool = False
+    eps: float = 0.4                   # Ape-X per-actor epsilon base
+    eps_alpha: float = 7.0
+    eps_eval: float = 0.0              # greedy at eval
+    # --- ddpg specifics (reference :167-168 + random_process.py) ---
+    critic_lr: float = 1e-3
+    ou_theta: float = 0.15
+    ou_sigma: float = 0.3
+    ou_mu: float = 0.0
+    # Keep the reference's single-optimizer gradient coupling between the
+    # DDPG policy loss and critic params?  The reference couples them
+    # (ddpg_learner.py:62-91: one zero_grad, both backwards, one Adam over
+    # all params).  Default False = decoupled per-net optimizers (the
+    # textbook DDPG), True reproduces reference behaviour bit-for-bit.
+    ddpg_coupled_update: bool = False
+
+
+def build_agent_params(agent_type: str, **overrides: Any) -> AgentParams:
+    """Per-family defaults, mirroring the if/elif in reference
+    utils/options.py:111-168."""
+    if agent_type == "dqn":
+        p = AgentParams(agent_type="dqn")
+    elif agent_type == "ddpg":
+        p = AgentParams(
+            agent_type="ddpg",
+            clip_grad=40.0,
+            actor_sync_freq=400,
+            actor_freq=2500,
+            learner_freq=1000,
+            evaluator_freq=60,
+            learn_start=250,
+            batch_size=64,
+            target_model_update=1e-3,
+        )
+    else:
+        raise ValueError(f"unknown agent_type: {agent_type}")
+    return dataclasses.replace(p, **overrides)
+
+
+@dataclass
+class ParallelParams:
+    """TPU topology knobs — no reference equivalent (the reference is a
+    single-node torch.multiprocessing program, SURVEY.md §2); this is where
+    the mesh/sharding design lives."""
+
+    # Logical mesh axes over jax.devices().  data parallel ("dp") carries the
+    # batch + gradient psum over ICI; model parallel ("mp") is available for
+    # tensor-sharded heads on wide models.
+    dp_size: int = -1                  # -1: all devices on dp
+    mp_size: int = 1
+    # Donate learner buffers (params/opt_state) to the jit step.
+    donate: bool = True
+    # Multi-host: call jax.distributed.initialize (DCN) before device init.
+    multihost: bool = False
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+
+
+@dataclass
+class Options:
+    """Aggregate of everything a run needs — equivalent of reference
+    ``Options`` (utils/options.py:171-175) but an explicit instance."""
+
+    # --- run identity (reference Params, utils/options.py:17-51) ---
+    mode: int = 1                      # 1 = train, 2 = test model_file
+    config: int = 1
+    seed: int = 100
+    refs: str = field(default_factory=_default_refs)
+    root_dir: str = field(default_factory=os.getcwd)
+    num_actors: int = 8
+    num_learners: int = 1
+    model_file: Optional[str] = None   # finetune/test source checkpoint
+    visualize: bool = True
+
+    agent_type: str = "dqn"
+    env_type: str = "fake"
+    game: str = "chain"
+    memory_type: str = "shared"
+    model_type: str = "dqn-mlp"
+
+    env_params: EnvParams = field(default_factory=EnvParams)
+    memory_params: MemoryParams = field(default_factory=MemoryParams)
+    model_params: ModelParams = field(default_factory=ModelParams)
+    agent_params: AgentParams = field(default_factory=AgentParams)
+    parallel_params: ParallelParams = field(default_factory=ParallelParams)
+
+    @property
+    def model_dir(self) -> str:
+        return os.path.join(self.root_dir, "models")
+
+    @property
+    def model_name(self) -> str:
+        # reference utils/options.py:42
+        return os.path.join(self.model_dir, f"{self.refs}")
+
+    @property
+    def log_dir(self) -> str:
+        # reference utils/options.py:51
+        return os.path.join(self.root_dir, "logs", self.refs)
+
+
+def build_options(config: int = 1, **overrides: Any) -> Options:
+    """Construct an Options from a CONFIGS row index + keyword overrides.
+
+    Mirrors what reference Params.__init__ does at utils/options.py:26
+    (unpacking the CONFIGS row) plus the shape bookkeeping EnvParams does at
+    :54-69, then applies overrides (our CLI affordance).
+    """
+    agent_type, env_type, game, memory_type, model_type = CONFIGS[config]
+
+    if "cnn" in model_type:
+        env_shape = dict(state_cha=4, state_hei=84, state_wid=84)
+        state_dtype = "uint8"
+    else:
+        # Low-dim envs report their own width at probe time; 0 = fill in
+        # from the env probe in main (reference main.py:23-31 does the same
+        # dummy-env probe).
+        env_shape = dict(state_cha=1, state_hei=1, state_wid=0)
+        state_dtype = "float32"
+
+    opt = Options(
+        config=config,
+        agent_type=agent_type,
+        env_type=env_type,
+        game=game,
+        memory_type=memory_type,
+        model_type=model_type,
+        env_params=EnvParams(env_type=env_type, game=game, **env_shape),
+        memory_params=MemoryParams(
+            memory_type=memory_type,
+            state_dtype=state_dtype,
+            enable_per=(memory_type == "prioritized"),
+        ),
+        model_params=ModelParams(model_type=model_type),
+        agent_params=build_agent_params(agent_type),
+    )
+
+    # Route simple top-level overrides to the right sub-dataclass.
+    for key, val in overrides.items():
+        routed = False
+        for sub in ("env_params", "memory_params", "model_params",
+                    "agent_params", "parallel_params"):
+            subobj = getattr(opt, sub)
+            if hasattr(subobj, key):
+                setattr(subobj, key, val)
+                routed = True
+        if hasattr(opt, key):
+            setattr(opt, key, val)
+            routed = True
+        if not routed:
+            raise ValueError(f"unknown option: {key}")
+
+    # Keep seed coherent across sub-params.
+    opt.env_params.seed = opt.seed
+    if opt.mode == 2 and opt.model_file is None:
+        # reference utils/options.py:45-48: test mode defaults to the
+        # current run's checkpoint path.
+        opt.model_file = opt.model_name
+    return opt

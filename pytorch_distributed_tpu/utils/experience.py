@@ -1,0 +1,65 @@
+"""Transition/experience schema.
+
+Equivalent of the reference ``Experience`` namedtuple
+(reference utils/helpers.py:8-16), extended with the per-sample effective
+discount ``gamma_n`` that the reference threads separately through its
+shared-memory arrays (reference core/memories/shared_memory.py:27,
+core/single_processes/dqn_actor.py:118-122): an n-step transition is
+
+    (s_t, a_t, R_t, gamma_n, s_{t+m}, terminal_{t+m})
+
+with ``R_t = sum_{k<m} gamma^k r_{t+k}`` and ``gamma_n = gamma^m`` where
+``m <= nstep`` shrinks near episode ends.  The learner target is then
+``R_t + gamma_n * bootstrap(s_{t+m}) * (1 - terminal)`` (reference
+dqn_learner.py:73-74).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+class Experience(NamedTuple):
+    """One env interaction as seen by the env wrapper
+    (reference utils/helpers.py:8: state0, action, reward, state1, terminal1).
+    """
+
+    state0: Optional[np.ndarray]
+    action: Optional[np.ndarray]
+    reward: Optional[float]
+    state1: Optional[np.ndarray]
+    terminal1: Optional[bool]
+
+
+def reset_experience() -> Experience:
+    # reference utils/helpers.py:10-16
+    return Experience(None, None, None, None, None)
+
+
+class Transition(NamedTuple):
+    """One n-step replay row — the six-array schema of the reference's
+    shared memory (reference core/memories/shared_memory.py:19-28)."""
+
+    state0: np.ndarray     # (*state_shape,) uint8 or float32
+    action: np.ndarray     # () int32 for discrete, (action_dim,) f32 for continuous
+    reward: np.ndarray     # () float32 — discounted n-step reward sum
+    gamma_n: np.ndarray    # () float32 — gamma**m effective bootstrap discount
+    state1: np.ndarray     # (*state_shape,)
+    terminal1: np.ndarray  # () float32 in {0,1}
+
+
+class Batch(NamedTuple):
+    """A sampled minibatch (leading batch dim on every field), as handed to
+    the jitted learner update."""
+
+    state0: np.ndarray
+    action: np.ndarray
+    reward: np.ndarray
+    gamma_n: np.ndarray
+    state1: np.ndarray
+    terminal1: np.ndarray
+    # PER extras; all-ones / arange for uniform replay.
+    weight: np.ndarray     # importance-sampling weights
+    index: np.ndarray      # buffer slots, for priority write-back

@@ -1,0 +1,59 @@
+"""Pytree parameter-update helpers.
+
+Functional equivalents of reference utils/helpers.py:19-25
+(``update_target_model``): the reference mutates a torch module in place;
+here both flavours are pure pytree→pytree functions that jit/fuse on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def hard_update(target: PyTree, online: PyTree) -> PyTree:
+    """Full copy — reference utils/helpers.py:24-25 (the every-N-steps
+    branch).  Pure: returns the new target pytree."""
+    return jax.tree_util.tree_map(lambda o: o, online)
+
+
+def soft_update(target: PyTree, online: PyTree, tau: float) -> PyTree:
+    """Polyak averaging ``t <- (1-tau) t + tau o`` — reference
+    utils/helpers.py:20-23 (the tau<1 branch, used by DDPG)."""
+    return jax.tree_util.tree_map(
+        lambda t, o: (1.0 - tau) * t + tau * o, target, online
+    )
+
+
+def periodic_update(target: PyTree, online: PyTree, step: jnp.ndarray,
+                    period: int) -> PyTree:
+    """Hard update every ``period`` learner steps, as a jit-safe select —
+    reference dqn_learner.py:91 calls update_target_model each step and the
+    helper internally gates on ``step % period == 0``."""
+    do = (step % period) == 0
+    return jax.tree_util.tree_map(
+        lambda t, o: jnp.where(do, o, t), target, online
+    )
+
+
+def update_target(target: PyTree, online: PyTree, step: jnp.ndarray,
+                  target_model_update: float) -> PyTree:
+    """Dispatch on the reference's overloaded ``target_model_update``
+    scalar: <1 means soft tau-update every step, >=1 means hard update every
+    N steps (reference utils/helpers.py:19-25)."""
+    if target_model_update < 1:
+        return soft_update(target, online, float(target_model_update))
+    return periodic_update(target, online, step, int(target_model_update))
+
+
+def global_norm(tree: PyTree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))
+
+
+def tree_size(tree: PyTree) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))

@@ -1,0 +1,36 @@
+"""Deterministic per-process randomness.
+
+The reference seeds ad hoc (torch.manual_seed at reference main.py:16, env
+seed ``seed + process_ind * num_envs_per_actor`` at reference
+core/envs/atari_env.py:16).  Here every process derives its streams from one
+root seed via stable folds, JAX-style.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+# Process-role salts so actor 0 and learner 0 never collide.
+ROLE_SALTS = {
+    "main": 0,
+    "actor": 1_000_000,
+    "learner": 2_000_000,
+    "evaluator": 3_000_000,
+    "tester": 4_000_000,
+    "logger": 5_000_000,
+    "env": 6_000_000,
+}
+
+
+def process_seed(root_seed: int, role: str, index: int = 0) -> int:
+    return (root_seed + ROLE_SALTS[role] + index) % (2 ** 31 - 1)
+
+
+def process_key(root_seed: int, role: str, index: int = 0) -> jax.Array:
+    return jax.random.fold_in(jax.random.PRNGKey(root_seed),
+                              ROLE_SALTS[role] + index)
+
+
+def np_rng(root_seed: int, role: str, index: int = 0) -> np.random.Generator:
+    return np.random.default_rng(process_seed(root_seed, role, index))

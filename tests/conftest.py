@@ -1,0 +1,19 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual XLA devices so mesh/sharding paths
+(data-parallel learner, sharded replay) are exercised without TPU hardware —
+the strategy SURVEY.md §4 prescribes for the missing reference test layer.
+Must set env vars before jax initialises a backend.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,59 @@
+import pytest
+
+from pytorch_distributed_tpu.config import CONFIGS, build_agent_params, build_options
+
+
+def test_configs_table_shape():
+    for row in CONFIGS:
+        assert len(row) == 5
+
+
+def test_reference_dqn_defaults():
+    # mirror reference utils/options.py:112-141
+    p = build_agent_params("dqn")
+    assert p.steps == 500000
+    assert p.gamma == 0.99
+    assert p.lr == 1e-4
+    assert p.batch_size == 128
+    assert p.learn_start == 5000
+    assert p.target_model_update == 250
+    assert p.nstep == 5
+    assert p.eps == 0.4 and p.eps_alpha == 7
+    assert p.actor_sync_freq == 100
+    assert p.enable_double is False
+
+
+def test_reference_ddpg_defaults():
+    # mirror reference utils/options.py:142-168
+    p = build_agent_params("ddpg")
+    assert p.batch_size == 64
+    assert p.clip_grad == 40.0
+    assert p.target_model_update == 1e-3
+    assert p.learn_start == 250
+    assert p.actor_sync_freq == 400
+
+
+def test_build_options_routes_overrides():
+    opt = build_options(config=1, num_actors=2, batch_size=32, memory_size=100)
+    assert opt.num_actors == 2
+    assert opt.agent_params.batch_size == 32
+    assert opt.memory_params.memory_size == 100
+    assert opt.agent_type == "dqn"
+    with pytest.raises(ValueError):
+        build_options(config=1, not_a_key=3)
+
+
+def test_cnn_config_shapes():
+    opt = build_options(config=0)
+    assert opt.env_params.state_shape == (4, 84, 84)
+    assert opt.memory_params.state_dtype == "uint8"
+
+
+def test_per_config():
+    opt = build_options(config=6)
+    assert opt.memory_params.enable_per is True
+
+
+def test_test_mode_defaults_model_file():
+    opt = build_options(config=1, mode=2)
+    assert opt.model_file == opt.model_name

@@ -1,0 +1,44 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.utils.helpers import (
+    hard_update, periodic_update, soft_update, update_target,
+)
+
+
+def _tree(val):
+    return {"w": jnp.full((3,), val), "b": jnp.asarray(val)}
+
+
+def test_soft_update():
+    out = soft_update(_tree(0.0), _tree(1.0), tau=0.1)
+    np.testing.assert_allclose(out["w"], 0.1, atol=1e-7)
+
+
+def test_hard_update():
+    out = hard_update(_tree(0.0), _tree(5.0))
+    np.testing.assert_allclose(out["b"], 5.0)
+
+
+def test_periodic_update_gates_on_step():
+    tgt, onl = _tree(0.0), _tree(7.0)
+    hit = periodic_update(tgt, onl, jnp.asarray(500), period=250)
+    miss = periodic_update(tgt, onl, jnp.asarray(501), period=250)
+    np.testing.assert_allclose(hit["w"], 7.0)
+    np.testing.assert_allclose(miss["w"], 0.0)
+
+
+def test_update_target_dispatch():
+    # tau-style (<1) vs periodic (>=1), reference utils/helpers.py:19-25
+    soft = update_target(_tree(0.0), _tree(1.0), jnp.asarray(3), 1e-3)
+    np.testing.assert_allclose(soft["b"], 1e-3, atol=1e-9)
+    hard = update_target(_tree(0.0), _tree(1.0), jnp.asarray(250), 250)
+    np.testing.assert_allclose(hard["b"], 1.0)
+
+
+def test_update_target_jits():
+    f = jax.jit(lambda t, o, s: update_target(t, o, s, 250))
+    out = f(_tree(0.0), _tree(2.0), jnp.asarray(0))
+    np.testing.assert_allclose(out["w"], 2.0)

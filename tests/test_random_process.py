@@ -1,0 +1,35 @@
+import numpy as np
+
+from pytorch_distributed_tpu.utils.random_process import OrnsteinUhlenbeckProcess
+
+
+def test_ou_mean_reversion():
+    p = OrnsteinUhlenbeckProcess(size=1, theta=0.5, mu=2.0, sigma=0.0, seed=0)
+    p.x_prev = np.array([0.0])
+    for _ in range(50):
+        x = p.sample()
+    assert abs(x[0] - 2.0) < 0.01
+
+
+def test_ou_sigma_anneal():
+    p = OrnsteinUhlenbeckProcess(size=1, sigma=1.0, sigma_min=0.1,
+                                 n_steps_annealing=10, seed=0)
+    for _ in range(20):
+        p.sample()
+    assert p.current_sigma == 0.1
+
+
+def test_ou_deterministic_given_seed():
+    a = OrnsteinUhlenbeckProcess(size=3, seed=42)
+    b = OrnsteinUhlenbeckProcess(size=3, seed=42)
+    for _ in range(5):
+        np.testing.assert_array_equal(a.sample(), b.sample())
+
+
+def test_ou_statistics():
+    # stationary std of OU: sigma * sqrt(dt) / sqrt(2 theta dt) approx
+    p = OrnsteinUhlenbeckProcess(size=10000, theta=0.15, sigma=0.3, seed=7)
+    for _ in range(200):
+        x = p.sample()
+    assert abs(np.mean(x)) < 0.05
+    assert 0.3 < np.std(x) < 0.8

@@ -1,0 +1,62 @@
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.utils.segment_tree import MinTree, SumTree
+
+
+def test_sum_tree_total_and_get():
+    t = SumTree(10)
+    t.set(np.arange(10), np.arange(10, dtype=np.float64))
+    assert t.total == pytest.approx(45.0)
+    assert t.get(np.array([3, 7])).tolist() == [3.0, 7.0]
+
+
+def test_sum_tree_find_matches_cumsum():
+    rng = np.random.default_rng(0)
+    t = SumTree(37)  # non-power-of-two capacity
+    pri = rng.uniform(0.0, 5.0, size=37)
+    t.set(np.arange(37), pri)
+    cum = np.cumsum(pri)
+    values = rng.uniform(0.0, t.total, size=1000)
+    found = t.find(values)
+    expected = np.searchsorted(cum, values, side="right")
+    np.testing.assert_array_equal(found, expected)
+
+
+def test_sum_tree_find_edges():
+    t = SumTree(4)
+    t.set(np.arange(4), np.array([1.0, 0.0, 2.0, 1.0]))
+    assert t.find(np.array([0.0]))[0] == 0
+    assert t.find(np.array([0.999]))[0] == 0
+    assert t.find(np.array([1.0]))[0] == 2  # zero-priority leaf 1 skipped
+    assert t.find(np.array([3.999]))[0] == 3
+    # v == total guard never returns out-of-range
+    assert t.find(np.array([4.0]))[0] <= 3
+
+
+def test_sum_tree_update_overwrites():
+    t = SumTree(8)
+    t.set(np.arange(8), np.ones(8))
+    t.set(np.array([2, 2, 5]), np.array([10.0, 3.0, 0.0]))  # duplicate: last wins
+    assert t.get(np.array([2]))[0] == 3.0
+    assert t.total == pytest.approx(6 * 1.0 + 3.0 + 0.0)
+
+
+def test_sum_tree_sampling_distribution():
+    rng = np.random.default_rng(1)
+    t = SumTree(4)
+    t.set(np.arange(4), np.array([1.0, 2.0, 3.0, 4.0]))
+    counts = np.zeros(4)
+    for _ in range(200):
+        idx = t.sample(64, rng)
+        np.add.at(counts, idx, 1)
+    freq = counts / counts.sum()
+    np.testing.assert_allclose(freq, np.array([0.1, 0.2, 0.3, 0.4]), atol=0.02)
+
+
+def test_min_tree():
+    t = MinTree(10)
+    t.set(np.arange(10), np.arange(1, 11, dtype=np.float64))
+    assert t.min == 1.0
+    t.set(np.array([9]), np.array([0.25]))
+    assert t.min == 0.25
