@@ -260,6 +260,16 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
     """
     agent_type, env_type, game, memory_type, model_type = CONFIGS[config]
 
+    # Selector overrides must land before sub-param construction so the
+    # per-family defaults they derive (hyperparams, shapes, dtypes, PER flag)
+    # stay consistent.
+    selectors = ("agent_type", "env_type", "game", "memory_type", "model_type")
+    agent_type = overrides.pop("agent_type", agent_type)
+    env_type = overrides.pop("env_type", env_type)
+    game = overrides.pop("game", game)
+    memory_type = overrides.pop("memory_type", memory_type)
+    model_type = overrides.pop("model_type", model_type)
+
     if "cnn" in model_type:
         env_shape = dict(state_cha=4, state_hei=84, state_wid=84)
         state_dtype = "uint8"
@@ -289,6 +299,7 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
 
     # Route simple top-level overrides to the right sub-dataclass.
     for key, val in overrides.items():
+        assert key not in selectors  # popped above
         routed = False
         for sub in ("env_params", "memory_params", "model_params",
                     "agent_params", "parallel_params"):

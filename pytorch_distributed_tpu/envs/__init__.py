@@ -1,0 +1,9 @@
+from pytorch_distributed_tpu.envs.base import DiscreteSpace, ContinuousSpace, Env
+from pytorch_distributed_tpu.envs.fake_env import FakeChainEnv
+from pytorch_distributed_tpu.envs.classic import CartPoleEnv, PendulumEnv, make_classic_env
+from pytorch_distributed_tpu.envs.pong_sim import PongSimEnv
+
+__all__ = [
+    "Env", "DiscreteSpace", "ContinuousSpace", "FakeChainEnv",
+    "CartPoleEnv", "PendulumEnv", "make_classic_env", "PongSimEnv",
+]

@@ -1,0 +1,155 @@
+"""ALE Atari env with the exact preprocessing contract of the reference.
+
+Re-design of reference core/envs/atari_env.py (cited per-behaviour below).
+Gated on an ALE backend being installed: prefers ``ale_py`` (current
+maintained package), falls back to legacy ``atari_py``; raises a clear error
+otherwise.  This image ships neither, so ``PongSimEnv`` (pong_sim.py) covers
+the visual-Pong pipeline in CI; this wrapper is exercised when a ROM-capable
+install is present.
+
+Behaviour parity checklist (each matching the reference):
+- per-process seeding ``seed + process_ind * num_envs_per_actor``
+  (reference atari_env.py:16)
+- episode frame cap ``early_stop`` via max_num_frames, sticky actions off,
+  manual frameskip (reference atari_env.py:20-24)
+- minimal action set (reference atari_env.py:27-28)
+- grayscale capture + bilinear resize to 84x84 (reference atari_env.py:53-58)
+- action repeat 4 with max-pool over the last two raw frames
+  (reference atari_env.py:89-104)
+- training mode: life loss => terminal, with ``just_died`` resume-by-noop
+  on the next reset instead of a full game reset
+  (reference atari_env.py:106-112, 115-121)
+- full reset performs up to 30 random no-ops (reference atari_env.py:122-129)
+- 4-frame history stack, uint8 end-to-end, norm_val 255
+  (reference atari_env.py:34, 43, 60-68)
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.envs.base import DiscreteSpace, Env
+
+try:  # pragma: no cover - exercised only where an ALE wheel exists
+    import cv2
+except Exception:  # noqa: BLE001
+    cv2 = None
+
+
+def _load_ale(game: str, seed: int, max_num_frames: int):
+    """Return (ale, minimal_actions) from whichever ALE package exists."""
+    try:
+        import ale_py  # type: ignore
+
+        ale = ale_py.ALEInterface()
+        ale.setInt("random_seed", seed)
+        ale.setFloat("repeat_action_probability", 0.0)  # sticky actions off
+        ale.setInt("max_num_frames_per_episode", max_num_frames)
+        rom = ale_py.roms.get_rom_path(game.replace("-", "_"))
+        ale.loadROM(rom)
+        return ale, list(ale.getMinimalActionSet())
+    except ImportError:
+        pass
+    try:
+        import atari_py  # type: ignore
+
+        ale = atari_py.ALEInterface()
+        ale.setInt(b"random_seed", seed)
+        ale.setFloat(b"repeat_action_probability", 0.0)
+        ale.setInt(b"max_num_frames_per_episode", max_num_frames)
+        ale.loadROM(atari_py.get_game_path(game.replace("-", "_")))
+        return ale, list(ale.getMinimalActionSet())
+    except ImportError:
+        raise ImportError(
+            "AtariEnv needs `ale_py` (or legacy `atari_py`) plus game ROMs; "
+            "neither is installed. Use env_type='pong-sim' for the ALE-free "
+            "Pong pipeline."
+        ) from None
+
+
+class AtariEnv(Env):
+    def __init__(self, env_params, process_ind: int = 0):
+        super().__init__(env_params, process_ind)
+        if cv2 is None:
+            raise ImportError("AtariEnv requires OpenCV (cv2) for resizing")
+        self.norm_val = 255.0
+        self.hist_len = env_params.state_cha
+        self.ale, self.actions = _load_ale(
+            env_params.game, self.seed, env_params.early_stop)
+        self.frame_stack: deque = deque(maxlen=self.hist_len)
+        self.lives = 0
+        self.just_died = False
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        return (self.hist_len, self.params.state_hei, self.params.state_wid)
+
+    @property
+    def action_space(self) -> DiscreteSpace:
+        return DiscreteSpace(len(self.actions))
+
+    # -- frame pipeline -----------------------------------------------------
+
+    def _screen(self) -> np.ndarray:
+        gray = self.ale.getScreenGrayscale()
+        gray = np.asarray(gray).reshape(self.ale.getScreenDims()[::-1] if
+                                        gray.ndim == 1 else gray.shape)
+        return cv2.resize(
+            gray.squeeze().astype(np.uint8),
+            (self.params.state_wid, self.params.state_hei),
+            interpolation=cv2.INTER_LINEAR,
+        )
+
+    def _stacked(self) -> np.ndarray:
+        return np.stack(self.frame_stack)
+
+    # -- env surface --------------------------------------------------------
+
+    def _reset(self) -> np.ndarray:
+        if self.training and self.just_died and not self.ale.game_over():
+            # life lost mid-game: resume with a single no-op, keep the stack
+            # (reference atari_env.py:115-121)
+            self.just_died = False
+            self.ale.act(0)
+            self.frame_stack.append(self._screen())
+        else:
+            self.ale.reset_game()
+            for _ in range(int(self.rng.integers(0, 31))):
+                self.ale.act(0)
+                if self.ale.game_over():
+                    self.ale.reset_game()
+            self.frame_stack.clear()
+            first = self._screen()
+            for _ in range(self.hist_len):
+                self.frame_stack.append(first)
+            self.just_died = False
+        self.lives = self.ale.lives()
+        return self._stacked()
+
+    def _step(self, action) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        ale_action = self.actions[int(action)]
+        reward = 0.0
+        prev = None
+        n = self.params.action_repetition
+        for k in range(n):
+            reward += self.ale.act(ale_action)
+            if k == n - 2:
+                prev = self._screen()
+        frame = self._screen()
+        if prev is not None:
+            frame = np.maximum(frame, prev)
+        self.frame_stack.append(frame)
+
+        terminal = bool(self.ale.game_over())
+        info: Dict[str, Any] = {"lives": self.ale.lives()}
+        if self.training:
+            new_lives = self.ale.lives()
+            if 0 < new_lives < self.lives:
+                # life-loss-as-terminal (reference atari_env.py:106-112)
+                terminal = True
+                self.just_died = True
+            self.lives = new_lives
+        return self._stacked(), float(reward), terminal, info

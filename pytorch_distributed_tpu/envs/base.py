@@ -1,0 +1,105 @@
+"""Env abstraction.
+
+Re-design of reference core/env.py:5-76.  The reference Env both steps the
+emulator and assembles ``Experience`` records internally
+(``_get_experience``, reference core/env.py:37-49); here the env exposes a
+plain ``reset() -> obs`` / ``step(a) -> (obs, reward, terminal, info)``
+surface and n-step experience assembly lives with the actor
+(``ops/nstep.py``) where it can be unit-tested in isolation — the layer the
+reference was missing (SURVEY.md §4).
+
+Mode semantics match the reference: ``train()`` enables life-loss-as-
+terminal + action repetition, ``eval()`` restores standard episode
+boundaries (reference core/env.py:29-35).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class DiscreteSpace:
+    n: int
+
+    def sample(self, rng: np.random.Generator) -> int:
+        return int(rng.integers(self.n))
+
+
+@dataclass(frozen=True)
+class ContinuousSpace:
+    """Box with symmetric policy convention: policies emit actions in
+    [-1, 1]^dim and the env rescales to [low, high]."""
+
+    dim: int
+    low: float = -1.0
+    high: float = 1.0
+
+    def sample(self, rng: np.random.Generator) -> np.ndarray:
+        return rng.uniform(-1.0, 1.0, size=(self.dim,)).astype(np.float32)
+
+    def denormalize(self, action: np.ndarray) -> np.ndarray:
+        a = np.clip(np.asarray(action, dtype=np.float32), -1.0, 1.0)
+        return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+
+class Env:
+    """Base env.  Subclasses implement ``_reset``/``_step`` and set
+    ``state_shape``, ``action_space``, ``norm_val``."""
+
+    def __init__(self, env_params, process_ind: int = 0):
+        self.params = env_params
+        self.process_ind = process_ind
+        # Per-process seeding, same scheme as reference
+        # core/envs/atari_env.py:16.
+        self.seed = env_params.seed + process_ind * env_params.num_envs_per_actor
+        self.rng = np.random.default_rng(self.seed)
+        self.training = True
+        # norm_val divides raw observations inside the model forward
+        # (reference core/envs/atari_env.py:66-68 / core/model.py).
+        self.norm_val: float = 1.0
+        self._episode_steps = 0
+
+    # -- mode switches (reference core/env.py:29-35) ------------------------
+
+    def train(self) -> None:
+        self.training = True
+
+    def eval(self) -> None:
+        self.training = False
+
+    # -- public surface -----------------------------------------------------
+
+    def reset(self) -> np.ndarray:
+        self._episode_steps = 0
+        return self._reset()
+
+    def step(self, action) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        obs, reward, terminal, info = self._step(action)
+        self._episode_steps += 1
+        if self.params.early_stop and self._episode_steps >= self.params.early_stop:
+            terminal = True
+            info.setdefault("truncated", True)
+        return obs, reward, terminal, info
+
+    def render(self) -> None:  # reference core/env.py:51 (optional)
+        pass
+
+    # -- to implement -------------------------------------------------------
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        raise NotImplementedError
+
+    @property
+    def action_space(self):
+        raise NotImplementedError
+
+    def _reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def _step(self, action) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        raise NotImplementedError

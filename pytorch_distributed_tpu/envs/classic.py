@@ -1,0 +1,142 @@
+"""Self-contained classic-control envs (no gym dependency).
+
+The reference's continuous-control (DDPG) path assumes gym MuJoCo-style envs
+(Pendulum/HalfCheetah per BASELINE.json tracked configs); neither gym nor
+MuJoCo is in this image, so the standard CartPole and Pendulum dynamics are
+implemented directly from their textbook equations (Barto-Sutton-Anderson
+1983 cart-pole; classic torque-limited pendulum swing-up).  Observations are
+float32 low-dim vectors (the reference's "mlp" state family, reference
+utils/options.py:57-60).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.envs.base import ContinuousSpace, DiscreteSpace, Env
+
+
+class CartPoleEnv(Env):
+    """Cart-pole balance, discrete push-left/push-right.
+
+    Dynamics constants follow the standard formulation (gravity 9.8, cart
+    mass 1.0, pole mass 0.1, half-length 0.5, force 10, Euler dt 0.02);
+    episode ends on |x|>2.4, |theta|>12deg, or 500 steps.
+    """
+
+    def __init__(self, env_params, process_ind: int = 0):
+        super().__init__(env_params, process_ind)
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.total_mass = self.masscart + self.masspole
+        self.length = 0.5
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self.max_steps = 500
+        self.state = np.zeros(4, dtype=np.float64)
+        self._steps = 0
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        return (4,)
+
+    @property
+    def action_space(self) -> DiscreteSpace:
+        return DiscreteSpace(2)
+
+    def _reset(self) -> np.ndarray:
+        self.state = self.rng.uniform(-0.05, 0.05, size=(4,))
+        self._steps = 0
+        return self.state.astype(np.float32)
+
+    def _step(self, action) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        x, x_dot, theta, theta_dot = self.state
+        force = self.force_mag if int(action) == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + self.polemass_length * theta_dot ** 2 * sintheta) \
+            / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * costheta ** 2 / self.total_mass))
+        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        self.state = np.array([x, x_dot, theta, theta_dot])
+        self._steps += 1
+        terminal = bool(
+            abs(x) > self.x_threshold
+            or abs(theta) > self.theta_threshold
+            or self._steps >= self.max_steps
+        )
+        return self.state.astype(np.float32), 1.0, terminal, {}
+
+
+class PendulumEnv(Env):
+    """Torque-limited pendulum swing-up, continuous 1-d action.
+
+    Standard formulation: theta'' = 3g/(2l) sin(theta) + 3/(m l^2) u with
+    g=10, m=1, l=1, dt=0.05, |u|<=2, cost = theta^2 + 0.1 theta'^2 +
+    0.001 u^2; observation (cos, sin, theta'); 200-step episodes.
+    Policies emit actions in [-1,1]; the env rescales to [-2,2]
+    (ContinuousSpace.denormalize).
+    """
+
+    def __init__(self, env_params, process_ind: int = 0):
+        super().__init__(env_params, process_ind)
+        self.max_speed = 8.0
+        self.max_torque = 2.0
+        self.dt = 0.05
+        self.g = 10.0
+        self.m = 1.0
+        self.l = 1.0
+        self.max_steps = 200
+        self.state = np.zeros(2, dtype=np.float64)
+        self._steps = 0
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        return (3,)
+
+    @property
+    def action_space(self) -> ContinuousSpace:
+        return ContinuousSpace(dim=1, low=-self.max_torque, high=self.max_torque)
+
+    def _obs(self) -> np.ndarray:
+        th, thdot = self.state
+        return np.array([np.cos(th), np.sin(th), thdot], dtype=np.float32)
+
+    def _reset(self) -> np.ndarray:
+        self.state = self.rng.uniform([-np.pi, -1.0], [np.pi, 1.0])
+        self._steps = 0
+        return self._obs()
+
+    def _step(self, action) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        th, thdot = self.state
+        u = float(np.squeeze(self.action_space.denormalize(action)))
+        angle = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = angle ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3.0 * self.g / (2.0 * self.l) * np.sin(th)
+                         + 3.0 / (self.m * self.l ** 2) * u) * self.dt
+        thdot = np.clip(thdot, -self.max_speed, self.max_speed)
+        th = th + thdot * self.dt
+        self.state = np.array([th, thdot])
+        self._steps += 1
+        terminal = self._steps >= self.max_steps
+        return self._obs(), float(-cost), terminal, {}
+
+
+def make_classic_env(env_params, process_ind: int = 0) -> Env:
+    game = env_params.game
+    if game == "cartpole":
+        return CartPoleEnv(env_params, process_ind)
+    if game == "pendulum":
+        return PendulumEnv(env_params, process_ind)
+    raise ValueError(f"unknown classic game: {game}")

@@ -1,0 +1,67 @@
+"""Deterministic chain MDP for tests and CPU smoke runs.
+
+The reference has no test env at all (SURVEY.md §4 recommends adding one);
+this fills that hole.  A length-L chain: state i (one-hot), action 1 moves
+right (+0 reward until the terminal right end pays +1), action 0 moves left
+(reward 0, floor at state 0).  Optimal policy: always right; the optimal
+n-step/TD values are known in closed form, which the learner-math tests use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.envs.base import DiscreteSpace, Env
+
+
+class FakeChainEnv(Env):
+    LENGTH = 8
+
+    def __init__(self, env_params, process_ind: int = 0, length: int | None = None):
+        super().__init__(env_params, process_ind)
+        self.length = length or self.LENGTH
+        self.pos = 0
+        self.norm_val = 1.0
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        return (self.length,)
+
+    @property
+    def action_space(self) -> DiscreteSpace:
+        return DiscreteSpace(2)
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros((self.length,), dtype=np.float32)
+        o[self.pos] = 1.0
+        return o
+
+    def _reset(self) -> np.ndarray:
+        self.pos = 0
+        return self._obs()
+
+    def _step(self, action) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        action = int(action)
+        if action == 1:
+            self.pos += 1
+        else:
+            self.pos = max(0, self.pos - 1)
+        terminal = self.pos >= self.length - 1
+        reward = 1.0 if terminal else 0.0
+        return self._obs(), reward, terminal, {}
+
+    def optimal_q(self, gamma: float) -> np.ndarray:
+        """Closed-form optimal Q table, shape (length-1, 2) over non-terminal
+        states; used by learner convergence tests."""
+        L = self.length
+        q = np.zeros((L - 1, 2), dtype=np.float64)
+        # value of being in state i under optimal (always-right) policy:
+        # gamma**(L-1-i-1) discounted terminal reward of 1.
+        v = lambda i: gamma ** (L - 2 - i) if i <= L - 2 else 0.0
+        for i in range(L - 1):
+            right = 1.0 if i + 1 == L - 1 else gamma * v(i + 1)
+            left = gamma * v(max(0, i - 1))
+            q[i] = [left, right]
+        return q

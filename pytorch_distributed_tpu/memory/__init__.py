@@ -1,0 +1,6 @@
+from pytorch_distributed_tpu.memory.base import Memory
+from pytorch_distributed_tpu.memory.shared_replay import SharedReplay
+from pytorch_distributed_tpu.memory.prioritized import PrioritizedReplay
+from pytorch_distributed_tpu.memory.device_replay import DeviceReplay
+
+__all__ = ["Memory", "SharedReplay", "PrioritizedReplay", "DeviceReplay"]

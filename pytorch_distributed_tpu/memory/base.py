@@ -1,0 +1,42 @@
+"""Replay-memory abstraction.
+
+Equivalent of reference core/memory.py:4-32 — shapes, capacity, and the
+circular ``size`` accounting (reference :22-26) — with an explicit
+``update_priorities`` hook so PER is part of the interface rather than the
+discarded argument it is in the reference
+(reference core/memories/shared_memory.py:45).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.utils.experience import Batch, Transition
+
+
+class Memory:
+    def __init__(self, capacity: int, state_shape: Tuple[int, ...],
+                 action_shape: Tuple[int, ...] = (),
+                 state_dtype: np.dtype = np.uint8,
+                 action_dtype: np.dtype = np.int32):
+        self.capacity = capacity
+        self.state_shape = tuple(state_shape)
+        self.action_shape = tuple(action_shape)
+        self.state_dtype = np.dtype(state_dtype)
+        self.action_dtype = np.dtype(action_dtype)
+
+    @property
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def feed(self, transition: Transition, priority: Optional[float] = None) -> None:
+        raise NotImplementedError
+
+    def sample(self, batch_size: int, rng: np.random.Generator) -> Batch:
+        raise NotImplementedError
+
+    def update_priorities(self, indices: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        """No-op for uniform replay."""

@@ -1,0 +1,140 @@
+"""HBM-resident replay buffer as a jitted functional ring buffer.
+
+TPU-native upgrade over the host shared-memory plane (SURVEY.md §7 step 4):
+the six transition arrays live in device HBM as jax Arrays, optionally
+sharded over the learner mesh's data axis, so sampling a minibatch never
+crosses the host-device boundary — the learner consumes batches straight
+from HBM and actors only pay one host->device transfer per *feed chunk*
+(amortised), not per sampled batch.
+
+Functional design: the buffer is a ``ReplayState`` pytree; ``feed`` and
+``sample`` are jit-compiled pure functions with donated state so XLA updates
+the rings in place.  Capacity is statically padded; the write cursor wraps
+with modular index arithmetic (the jit-safe equivalent of the reference's
+circular cursor, reference core/memories/shared_memory.py:45-57).
+
+No reference equivalent — the reference buffer is host memory only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.utils.experience import Batch, Transition
+
+
+class ReplayState(NamedTuple):
+    state0: jax.Array
+    action: jax.Array
+    reward: jax.Array
+    gamma_n: jax.Array
+    state1: jax.Array
+    terminal1: jax.Array
+    pos: jax.Array        # int32 write cursor
+    fill: jax.Array       # int32 number of valid rows
+
+
+def _feed(state: ReplayState, chunk: Transition, capacity: int) -> ReplayState:
+    n = chunk.reward.shape[0]
+    idx = (state.pos + jnp.arange(n, dtype=jnp.int32)) % capacity
+    return ReplayState(
+        state0=state.state0.at[idx].set(chunk.state0),
+        action=state.action.at[idx].set(chunk.action),
+        reward=state.reward.at[idx].set(chunk.reward),
+        gamma_n=state.gamma_n.at[idx].set(chunk.gamma_n),
+        state1=state.state1.at[idx].set(chunk.state1),
+        terminal1=state.terminal1.at[idx].set(chunk.terminal1),
+        pos=(state.pos + n) % capacity,
+        fill=jnp.minimum(state.fill + n, capacity),
+    )
+
+
+def _sample(state: ReplayState, key: jax.Array, batch_size: int) -> Batch:
+    idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(state.fill, 1))
+    return Batch(
+        state0=state.state0[idx],
+        action=state.action[idx],
+        reward=state.reward[idx],
+        gamma_n=state.gamma_n[idx],
+        state1=state.state1[idx],
+        terminal1=state.terminal1[idx],
+        weight=jnp.ones((batch_size,), dtype=jnp.float32),
+        index=idx.astype(jnp.int32),
+    )
+
+
+class DeviceReplay:
+    """Convenience stateful wrapper around the functional ring.
+
+    ``mesh``/``axis`` shard every buffer row-wise across the data axis so
+    each device holds capacity/n_dev rows of the ring and gathers ride ICI.
+    """
+
+    def __init__(self, capacity: int, state_shape: Tuple[int, ...],
+                 action_shape: Tuple[int, ...] = (),
+                 state_dtype=np.uint8, action_dtype=np.int32,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 axis: str = "dp"):
+        self.capacity = capacity
+        self.state_shape = tuple(state_shape)
+        self.action_shape = tuple(action_shape)
+        self.state_dtype = jnp.dtype(state_dtype)
+        self.action_dtype = jnp.dtype(action_dtype)
+        self.mesh = mesh
+        self.axis = axis
+
+        if mesh is not None:
+            ndev = mesh.shape[axis]
+            assert capacity % ndev == 0, (
+                f"capacity {capacity} must divide mesh axis {axis}={ndev}")
+            P = jax.sharding.PartitionSpec
+            self._row_sharding = jax.sharding.NamedSharding(mesh, P(axis))
+            self._scalar_sharding = jax.sharding.NamedSharding(mesh, P())
+        else:
+            self._row_sharding = None
+            self._scalar_sharding = None
+
+        self.state = self._init_state()
+        self._feed_fn = jax.jit(
+            functools.partial(_feed, capacity=capacity), donate_argnums=0)
+        self._sample_fn = jax.jit(
+            _sample, static_argnames="batch_size", donate_argnums=())
+
+    def _init_state(self) -> ReplayState:
+        N = self.capacity
+
+        def alloc(shape, dtype, sharded=True):
+            arr = jnp.zeros(shape, dtype=dtype)
+            if self._row_sharding is not None:
+                arr = jax.device_put(
+                    arr, self._row_sharding if sharded else self._scalar_sharding)
+            return arr
+
+        return ReplayState(
+            state0=alloc((N, *self.state_shape), self.state_dtype),
+            action=alloc((N, *self.action_shape), self.action_dtype),
+            reward=alloc((N,), jnp.float32),
+            gamma_n=alloc((N,), jnp.float32),
+            state1=alloc((N, *self.state_shape), self.state_dtype),
+            terminal1=alloc((N,), jnp.float32),
+            pos=alloc((), jnp.int32, sharded=False),
+            fill=alloc((), jnp.int32, sharded=False),
+        )
+
+    @property
+    def size(self) -> int:
+        return int(self.state.fill)
+
+    def feed_chunk(self, chunk: Transition) -> None:
+        """Host->device ingest of a chunk of transitions (leading dim = chunk
+        size).  Chunk sizes should be fixed (e.g. the actor flush size) to
+        avoid retracing."""
+        self.state = self._feed_fn(self.state, chunk)
+
+    def sample(self, batch_size: int, key: jax.Array) -> Batch:
+        return self._sample_fn(self.state, key, batch_size=batch_size)

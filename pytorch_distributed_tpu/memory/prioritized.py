@@ -1,0 +1,126 @@
+"""Proportional prioritized experience replay (PER).
+
+This finishes what the reference left as a TODO: its ``enable_per`` flag is
+off with "not completed for now" (reference utils/options.py:82), its
+``priority`` argument is threaded into feed() and discarded (reference
+core/memories/shared_memory.py:45), and its sum-tree sketch is dead code
+(reference utils/segment_tree.py).  Here: a single-owner (learner-process)
+buffer with proportional sampling via the vectorized SumTree, initial
+priorities from actor-computed TD estimates (the plumbing the reference
+already anticipated at dqn_actor.py:113-115), importance-sampling weights
+normalised by the max weight via a MinTree, and priority write-back after
+each learner step.  Schedule follows Ape-X: priority exponent alpha,
+IS exponent beta annealed to 1.
+
+Single-owner by design: actors stream transitions to the owner over a
+queue (agents/actor.py) instead of writing shared pages, so the trees need
+no cross-process locking.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.memory.base import Memory
+from pytorch_distributed_tpu.utils.experience import Batch, Transition
+from pytorch_distributed_tpu.utils.segment_tree import MinTree, SumTree
+
+
+class PrioritizedReplay(Memory):
+    def __init__(self, capacity: int, state_shape: Tuple[int, ...],
+                 action_shape: Tuple[int, ...] = (),
+                 state_dtype=np.uint8, action_dtype=np.int32,
+                 priority_exponent: float = 0.6,
+                 importance_weight: float = 0.4,
+                 importance_anneal_steps: int = 500000,
+                 epsilon: float = 1e-6):
+        super().__init__(capacity, state_shape, action_shape,
+                         state_dtype, action_dtype)
+        N = capacity
+        self.state0 = np.zeros((N, *self.state_shape), dtype=self.state_dtype)
+        self.action = np.zeros((N, *self.action_shape), dtype=self.action_dtype)
+        self.reward = np.zeros((N,), dtype=np.float32)
+        self.gamma_n = np.zeros((N,), dtype=np.float32)
+        self.state1 = np.zeros((N, *self.state_shape), dtype=self.state_dtype)
+        self.terminal1 = np.zeros((N,), dtype=np.float32)
+        self.sum_tree = SumTree(N)
+        self.min_tree = MinTree(N)
+        self.alpha = priority_exponent
+        self.beta0 = importance_weight
+        self.beta_steps = importance_anneal_steps
+        self.eps = epsilon
+        self.max_priority = 1.0
+        self._pos = 0
+        self._full = False
+        self._samples_drawn = 0
+
+    @property
+    def size(self) -> int:
+        return self.capacity if self._full else self._pos
+
+    @property
+    def beta(self) -> float:
+        frac = min(1.0, self._samples_drawn / max(1, self.beta_steps))
+        return self.beta0 + (1.0 - self.beta0) * frac
+
+    def _priority(self, p: Optional[float]) -> float:
+        # new transitions default to the running max priority so everything
+        # is replayed at least once (Ape-X / PER standard)
+        base = self.max_priority if p is None else abs(float(p)) + self.eps
+        return base ** self.alpha
+
+    def feed(self, transition: Transition,
+             priority: Optional[float] = None) -> None:
+        i = self._pos
+        self.state0[i] = transition.state0
+        self.action[i] = transition.action
+        self.reward[i] = transition.reward
+        self.gamma_n[i] = transition.gamma_n
+        self.state1[i] = transition.state1
+        self.terminal1[i] = transition.terminal1
+        pr = self._priority(priority)
+        self.sum_tree.set(i, pr)
+        self.min_tree.set(i, pr)
+        self.max_priority = max(self.max_priority,
+                                pr ** (1.0 / self.alpha) if self.alpha else pr)
+        self._pos = (i + 1) % self.capacity
+        self._full = self._full or self._pos == 0
+
+    def feed_batch(self, ts: Transition, priorities=None) -> None:
+        n = len(ts.reward)
+        for j in range(n):
+            self.feed(
+                Transition(ts.state0[j], ts.action[j], ts.reward[j],
+                           ts.gamma_n[j], ts.state1[j], ts.terminal1[j]),
+                None if priorities is None else priorities[j])
+
+    def sample(self, batch_size: int, rng: np.random.Generator) -> Batch:
+        assert self.size > 0
+        idx = self.sum_tree.sample(batch_size, rng)
+        self._samples_drawn += 1
+        probs = self.sum_tree.get(idx) / self.sum_tree.total
+        beta = self.beta
+        weights = (self.size * probs) ** (-beta)
+        min_prob = self.min_tree.min / self.sum_tree.total
+        max_weight = (self.size * min_prob) ** (-beta)
+        weights = (weights / max_weight).astype(np.float32)
+        return Batch(
+            state0=self.state0[idx].copy(),
+            action=self.action[idx].copy(),
+            reward=self.reward[idx].copy(),
+            gamma_n=self.gamma_n[idx].copy(),
+            state1=self.state1[idx].copy(),
+            terminal1=self.terminal1[idx].copy(),
+            weight=weights,
+            index=idx.astype(np.int32),
+        )
+
+    def update_priorities(self, indices: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        priorities = np.abs(np.asarray(priorities, dtype=np.float64)) + self.eps
+        pr = priorities ** self.alpha
+        self.sum_tree.set(indices, pr)
+        self.min_tree.set(indices, pr)
+        self.max_priority = max(self.max_priority, float(priorities.max()))

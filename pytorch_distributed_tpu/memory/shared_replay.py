@@ -1,0 +1,147 @@
+"""Cross-process shared-memory replay ring buffer (uniform sampling).
+
+TPU-host equivalent of the reference's inter-process data plane
+(reference core/memories/shared_memory.py): six preallocated flat arrays of
+capacity ``memory_size`` — state0/state1 (uint8 for images, float32 for
+low-dim; reference :19-24), action/reward/gamma_n/terminal (reference
+:25-28) — that all actor and learner processes address directly.  Where the
+reference shares torch tensors via ``.share_memory_()`` (reference :30-35),
+here the backing store is ``multiprocessing.Array`` pages wrapped as numpy
+views, which survive ``spawn`` pickling; the write cursor and full flag are
+``mp.Value``s and one global ``mp.Lock`` serialises every feed/sample
+(reference :16-17, 37, 69-75).
+
+This is the "shared" memory_type.  The prioritized variant lives in
+prioritized.py; the HBM-resident variant in device_replay.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import multiprocessing as mp
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.memory.base import Memory
+from pytorch_distributed_tpu.utils.experience import Batch, Transition
+
+_CTYPES = {
+    np.dtype(np.uint8): ctypes.c_uint8,
+    np.dtype(np.float32): ctypes.c_float,
+    np.dtype(np.int32): ctypes.c_int32,
+}
+
+# all shared primitives come from the spawn context — the start method the
+# whole framework uses (reference main.py:13 mp.set_start_method('spawn'))
+_CTX = mp.get_context("spawn")
+
+
+def _shared_array(shape: Tuple[int, ...], dtype: np.dtype):
+    n = int(np.prod(shape)) if shape else 1
+    raw = _CTX.Array(_CTYPES[np.dtype(dtype)], n, lock=False)
+    return raw
+
+
+def _view(raw, shape: Tuple[int, ...], dtype: np.dtype) -> np.ndarray:
+    return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+
+class SharedReplay(Memory):
+    def __init__(self, capacity: int, state_shape: Tuple[int, ...],
+                 action_shape: Tuple[int, ...] = (),
+                 state_dtype=np.uint8, action_dtype=np.int32):
+        super().__init__(capacity, state_shape, action_shape,
+                         state_dtype, action_dtype)
+        N = capacity
+        # the six-array layout (reference shared_memory.py:19-28)
+        self._raw = dict(
+            state0=_shared_array((N, *self.state_shape), self.state_dtype),
+            action=_shared_array((N, *self.action_shape), self.action_dtype),
+            reward=_shared_array((N,), np.float32),
+            gamma_n=_shared_array((N,), np.float32),
+            state1=_shared_array((N, *self.state_shape), self.state_dtype),
+            terminal1=_shared_array((N,), np.float32),
+        )
+        self._pos = _CTX.Value("l", 0, lock=False)     # reference :16
+        self._full = _CTX.Value("b", 0, lock=False)    # reference :17
+        self._count = _CTX.Value("l", 0, lock=False)   # total feeds (stats)
+        self._lock = _CTX.Lock()                       # reference :37
+        self._bind_views()
+
+    # -- pickling across spawn ---------------------------------------------
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        for k in list(d):
+            if k.startswith("_np_"):
+                del d[k]
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._bind_views()
+
+    def _bind_views(self) -> None:
+        N = self.capacity
+        shapes = dict(
+            state0=(N, *self.state_shape), action=(N, *self.action_shape),
+            reward=(N,), gamma_n=(N,), state1=(N, *self.state_shape),
+            terminal1=(N,),
+        )
+        dtypes = dict(
+            state0=self.state_dtype, action=self.action_dtype,
+            reward=np.float32, gamma_n=np.float32,
+            state1=self.state_dtype, terminal1=np.float32,
+        )
+        for k, raw in self._raw.items():
+            setattr(self, f"_np_{k}", _view(raw, shapes[k], dtypes[k]))
+
+    # -- Memory interface ---------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        # circular accounting (reference core/memory.py:22-26)
+        return self.capacity if self._full.value else self._pos.value
+
+    @property
+    def total_feeds(self) -> int:
+        return self._count.value
+
+    def feed(self, transition: Transition,
+             priority: Optional[float] = None) -> None:
+        # one write at the cursor, circular (reference shared_memory.py:45-57);
+        # priority accepted for interface parity and ignored — uniform replay
+        with self._lock:
+            i = self._pos.value
+            self._np_state0[i] = transition.state0
+            self._np_action[i] = transition.action
+            self._np_reward[i] = transition.reward
+            self._np_gamma_n[i] = transition.gamma_n
+            self._np_state1[i] = transition.state1
+            self._np_terminal1[i] = transition.terminal1
+            nxt = i + 1
+            if nxt >= self.capacity:
+                self._full.value = 1
+                nxt = 0
+            self._pos.value = nxt
+            self._count.value += 1
+
+    def sample(self, batch_size: int, rng: np.random.Generator) -> Batch:
+        # uniform indices + float cast of states (reference
+        # shared_memory.py:59-67); copies so the learner batch is stable
+        # even while actors keep writing
+        with self._lock:
+            size = self.size
+            assert size > 0, "sampling from empty replay"
+            idx = rng.integers(0, size, size=batch_size)
+            return Batch(
+                state0=self._np_state0[idx].copy(),
+                action=self._np_action[idx].copy(),
+                reward=self._np_reward[idx].copy(),
+                gamma_n=self._np_gamma_n[idx].copy(),
+                state1=self._np_state1[idx].copy(),
+                terminal1=self._np_terminal1[idx].copy(),
+                weight=np.ones(batch_size, dtype=np.float32),
+                index=idx.astype(np.int32),
+            )

@@ -1,0 +1,11 @@
+from pytorch_distributed_tpu.models.dqn_cnn import DqnCnnModel
+from pytorch_distributed_tpu.models.dqn_mlp import DqnMlpModel
+from pytorch_distributed_tpu.models.ddpg_mlp import DdpgMlpModel
+from pytorch_distributed_tpu.models.policies import (
+    build_epsilon_greedy_act, build_ddpg_act, apex_epsilon,
+)
+
+__all__ = [
+    "DqnCnnModel", "DqnMlpModel", "DdpgMlpModel",
+    "build_epsilon_greedy_act", "build_ddpg_act", "apex_epsilon",
+]

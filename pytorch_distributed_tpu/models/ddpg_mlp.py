@@ -1,0 +1,78 @@
+"""DDPG actor-critic as one Flax module.
+
+Re-design of reference core/models/ddpg_mlp_model.py: a single module
+holding both networks —
+
+- actor: state -> 300 tanh -> 200 tanh -> tanh action in [-1,1]
+  (reference :16-23);
+- critic: state -> 400 tanh, concat(action) -> 300 tanh -> scalar Q
+  (reference :26-35);
+- init: fan-in uniform hidden layers with uniform(±3e-3) output layers,
+  the init the reference actually applies (reference :38-56).
+
+Exposed as ``forward_actor`` / ``forward_critic`` methods so the learner can
+differentiate each path separately (the reference couples them through one
+optimizer — see AgentParams.ddpg_coupled_update).  Actions are normalised to
+[-1,1]; envs rescale via ContinuousSpace.denormalize.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+from flax.linen.initializers import uniform as uniform_init
+from jax.nn.initializers import variance_scaling
+
+# fan-in uniform, the classic DDPG hidden init (1/sqrt(fan_in))
+_fanin = variance_scaling(scale=1.0 / 3.0, mode="fan_in",
+                          distribution="uniform")
+
+
+def _out_init(scale: float = 3e-3):
+    def init(key, shape, dtype=jnp.float32):
+        import jax
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+    return init
+
+
+class DdpgMlpModel(nn.Module):
+    action_dim: int
+    norm_val: float = 1.0
+    actor_hidden: Tuple[int, int] = (300, 200)
+    critic_hidden: Tuple[int, int] = (400, 300)
+
+    def setup(self):
+        a1, a2 = self.actor_hidden
+        c1, c2 = self.critic_hidden
+        self.actor_l1 = nn.Dense(a1, kernel_init=_fanin)
+        self.actor_l2 = nn.Dense(a2, kernel_init=_fanin)
+        self.actor_out = nn.Dense(self.action_dim, kernel_init=_out_init(),
+                                  bias_init=uniform_init(3e-3))
+        self.critic_l1 = nn.Dense(c1, kernel_init=_fanin)
+        self.critic_l2 = nn.Dense(c2, kernel_init=_fanin)
+        self.critic_out = nn.Dense(1, kernel_init=_out_init(),
+                                   bias_init=uniform_init(3e-3))
+
+    def _norm(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(jnp.float32) / self.norm_val
+        return x.reshape((x.shape[0], -1))
+
+    def forward_actor(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = self._norm(x)
+        x = nn.tanh(self.actor_l1(x))
+        x = nn.tanh(self.actor_l2(x))
+        return nn.tanh(self.actor_out(x))
+
+    def forward_critic(self, x: jnp.ndarray, a: jnp.ndarray) -> jnp.ndarray:
+        x = self._norm(x)
+        h = nn.tanh(self.critic_l1(x))
+        h = jnp.concatenate([h, a.reshape((a.shape[0], -1))], axis=-1)
+        h = nn.tanh(self.critic_l2(h))
+        return self.critic_out(h).squeeze(-1)
+
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        # combined pass (reference ddpg_mlp_model.py:66-72): Q(s, pi(s))
+        a = self.forward_actor(x)
+        return a, self.forward_critic(x, a)

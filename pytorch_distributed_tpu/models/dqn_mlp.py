@@ -1,0 +1,34 @@
+"""Low-dim MLP Q-network.
+
+Re-design of reference core/models/dqn_mlp_model.py:18-26 (3 hidden ReLU
+layers of ``hidden_dim``).  Unlike the reference — where this model exists
+but is left unregistered in the factory (reference utils/factory.py:42-43) —
+it is registered here and carries the smoke-test configs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+from flax.linen.initializers import orthogonal, zeros_init
+
+
+class DqnMlpModel(nn.Module):
+    action_space: int
+    hidden_dim: int = 256
+    norm_val: float = 1.0
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.compute_dtype) / jnp.asarray(
+            self.norm_val, dtype=self.compute_dtype)
+        x = x.reshape((x.shape[0], -1))
+        for _ in range(3):
+            x = nn.Dense(self.hidden_dim, dtype=self.compute_dtype,
+                         kernel_init=orthogonal(jnp.sqrt(2.0)),
+                         bias_init=zeros_init())(x)
+            x = nn.relu(x)
+        q = nn.Dense(self.action_space, dtype=self.compute_dtype,
+                     kernel_init=orthogonal(1.0), bias_init=zeros_init())(x)
+        return q.astype(jnp.float32)

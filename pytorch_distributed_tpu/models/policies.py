@@ -1,0 +1,78 @@
+"""Action-selection as pure jitted functions.
+
+The reference folds action selection into the torch modules
+(``get_action``: reference core/models/dqn_cnn_model.py:58-78,
+ddpg_mlp_model.py:74-78).  TPU-first, these are standalone functions of
+``(params, obs, key, ...)`` with explicit randomness, jit-compiled once and
+reused by actors / evaluators / testers; they are batch-shaped so one call
+can serve a whole vector of envs (the batched-inference answer to the
+reference's latency-bound batch-1 actor forward, SURVEY.md §7 "hard
+parts").
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def apex_epsilon(process_ind: int, num_actors: int,
+                 eps: float = 0.4, eps_alpha: float = 7.0) -> float:
+    """Ape-X per-actor exploration schedule
+    ``eps ** (1 + i/(N-1) * alpha)`` (reference dqn_actor.py:33-36, with the
+    reference's 1-based indexing of actors and its single-actor debug value).
+    """
+    if num_actors <= 1:
+        return 0.1  # reference dqn_actor.py:33-34 debug branch
+    frac = process_ind / (num_actors - 1)
+    return float(eps ** (1.0 + frac * eps_alpha))
+
+
+def build_epsilon_greedy_act(apply_fn: Callable) -> Callable:
+    """eps-greedy over a Q-network.
+
+    Returns a jitted ``act(params, obs[B,...], key, eps) ->
+    (action[B], q_sel[B], q_max[B])``; q_sel/q_max feed PER initial
+    priorities, mirroring the tuple the reference returns when PER is on
+    (reference dqn_cnn_model.py:65-78) — here they are always returned
+    (cost-free under jit).
+    """
+
+    def act(params, obs, key, eps):
+        q = apply_fn(params, obs)                        # (B, A)
+        batch, num_actions = q.shape
+        greedy = jnp.argmax(q, axis=-1)
+        key_explore, key_choice = jax.random.split(key)
+        random_a = jax.random.randint(key_choice, (batch,), 0, num_actions)
+        explore = jax.random.uniform(key_explore, (batch,)) < eps
+        action = jnp.where(explore, random_a, greedy)
+        q_sel = jnp.take_along_axis(q, action[:, None], axis=-1)[:, 0]
+        return action, q_sel, jnp.max(q, axis=-1)
+
+    return jax.jit(act)
+
+
+def build_greedy_act(apply_fn: Callable) -> Callable:
+    """Pure-greedy variant for evaluator/tester (reference evaluators.py:56-86
+    runs eps=0 episodes)."""
+
+    def act(params, obs):
+        q = apply_fn(params, obs)
+        return jnp.argmax(q, axis=-1), jnp.max(q, axis=-1)
+
+    return jax.jit(act)
+
+
+def build_ddpg_act(actor_apply_fn: Callable) -> Callable:
+    """Deterministic policy forward ``act(params, obs[B,...]) -> action[B,d]``
+    in [-1,1]; exploration noise (OU) is added host-side by the actor
+    process, as in the reference (reference ddpg_mlp_model.py:74-78 returns
+    action + noise; here noise stays outside the jitted function so the OU
+    state lives with the process)."""
+
+    def act(params, obs):
+        return actor_apply_fn(params, obs)
+
+    return jax.jit(act)

@@ -1,0 +1,126 @@
+"""n-step transition assembly.
+
+The trickiest logic in the reference lives inline in its actor loops as four
+parallel deques with a steady-state push of the [-2] window entry and a
+two-case episode-end flush (reference core/single_processes/dqn_actor.py:
+54-61, 110-122, 133-163) — and has no tests.  Here it is a standalone,
+unit-tested component with the same semantics, defined constructively:
+
+For each time step t of an episode, emit the n-step transition
+
+    (s_t, a_t, R_t, gamma_m, s_{t+m}, term_{t+m}),
+    R_t = sum_{k=0}^{m-1} gamma^k r_{t+k},  gamma_m = gamma^m,
+    m = min(nstep, T - t)   (T = episode length)
+
+i.e. windows shrink at the episode tail instead of bootstrapping across the
+boundary, and the stored effective discount gamma_m is what the learner uses
+for its bootstrap term (reference dqn_learner.py:73-74 with the per-sample
+``gamma1s``).  Terminal flag is 1 iff the window reaches the true episode
+end (so truncation via early_stop still bootstraps).
+
+Two implementations:
+- ``NStepAssembler`` — incremental/host-side, O(1) per step, used by actor
+  processes;
+- ``nstep_from_episode`` — vectorized over a whole recorded episode
+  (numpy), used by tests as the ground truth and by batched/vector-env
+  actors to convert rollout chunks in one shot.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from pytorch_distributed_tpu.utils.experience import Transition
+
+
+class NStepAssembler:
+    """Feed (s, a, r, s', terminal, truncated) once per env step; yields zero
+    or more finished n-step ``Transition``s per feed.  Call ``flush()`` (or
+    feed a terminal step) at episode end."""
+
+    def __init__(self, nstep: int, gamma: float):
+        assert nstep >= 1
+        self.nstep = nstep
+        self.gamma = gamma
+        self._buf: deque = deque()  # pending (s, a, r, s_last, term) windows
+
+    def feed(self, state0, action, reward, state1, terminal: bool,
+             truncated: bool = False) -> List[Transition]:
+        """``truncated`` marks episode ends that should still bootstrap
+        (time-limit truncation): windows close but terminal stays 0."""
+        self._buf.append([state0, action, 0.0, 0, state1, False])
+        # accumulate this reward into every open window
+        for row in self._buf:
+            row[2] += (self.gamma ** row[3]) * reward
+            row[3] += 1
+            row[4] = state1
+        out: List[Transition] = []
+        if terminal or truncated:
+            # every open window closes at s_{T}; they are terminal iff the
+            # episode truly ended (truncation still bootstraps)
+            is_true_terminal = terminal and not truncated
+            while self._buf:
+                out.append(self._emit(self._buf.popleft(),
+                                      terminal=is_true_terminal))
+        else:
+            # steady state: the oldest window reaches n steps
+            while self._buf and self._buf[0][3] >= self.nstep:
+                out.append(self._emit(self._buf.popleft(), terminal=False))
+        return out
+
+    def flush(self) -> List[Transition]:
+        """Close all pending windows without a terminal (e.g. an actor
+        shutting down mid-episode); emitted rows bootstrap from their last
+        state."""
+        out = [self._emit(row, terminal=False) for row in self._buf]
+        self._buf.clear()
+        return out
+
+    def _emit(self, row, terminal: bool) -> Transition:
+        state0, action, r_sum, m, state1, _ = row
+        return Transition(
+            state0=np.asarray(state0),
+            action=np.asarray(action),
+            reward=np.float32(r_sum),
+            gamma_n=np.float32(self.gamma ** m),
+            state1=np.asarray(state1),
+            terminal1=np.float32(1.0 if terminal else 0.0),
+        )
+
+    def reset(self) -> None:
+        self._buf.clear()
+
+    @property
+    def pending(self) -> int:
+        return len(self._buf)
+
+
+def nstep_from_episode(states: np.ndarray, actions: np.ndarray,
+                       rewards: np.ndarray, nstep: int, gamma: float,
+                       terminal: bool = True) -> Transition:
+    """Vectorized ground truth over one episode.
+
+    states: (T+1, ...) including the final state; actions/rewards: (T,).
+    Returns a Transition batch of T rows.  ``terminal``=False marks a
+    truncated episode (bootstrap through the last state).
+    """
+    T = len(rewards)
+    assert states.shape[0] == T + 1
+    m = np.minimum(nstep, T - np.arange(T))
+    r_sum = np.zeros(T, dtype=np.float64)
+    for k in range(nstep):
+        valid = np.arange(T) + k < T
+        r_sum[valid] += (gamma ** k) * rewards[np.arange(T)[valid] + k]
+    end = np.arange(T) + m
+    term = np.where(end == T, 1.0 if terminal else 0.0, 0.0)
+    return Transition(
+        state0=states[:T],
+        action=actions,
+        reward=r_sum.astype(np.float32),
+        gamma_n=(gamma ** m).astype(np.float32),
+        state1=states[end],
+        terminal1=term.astype(np.float32),
+    )

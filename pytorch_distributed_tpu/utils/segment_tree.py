@@ -42,6 +42,8 @@ class SumTree:
         if indices.ndim == 0:
             indices = indices[None]
             priorities = priorities[None]
+        if indices.size == 0:
+            return
         assert np.all((indices >= 0) & (indices < self.capacity))
         assert np.all(priorities >= 0)
         nodes = indices + self._size
@@ -74,6 +76,8 @@ class SumTree:
         values = np.asarray(values, dtype=np.float64).copy()
         if values.ndim == 0:
             values = values[None]
+        if values.size == 0:
+            return values.astype(np.int64)
         nodes = np.ones_like(values, dtype=np.int64)
         while nodes[0] < self._size:  # all nodes are on the same level
             left = 2 * nodes
@@ -117,6 +121,9 @@ class MinTree:
         if indices.ndim == 0:
             indices = indices[None]
             priorities = priorities[None]
+        if indices.size == 0:
+            return
+        assert np.all((indices >= 0) & (indices < self.capacity))
         nodes = indices + self._size
         self.tree[nodes] = priorities
         nodes = np.unique(nodes) >> 1

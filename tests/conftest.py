@@ -16,4 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Some images pre-import jax via sitecustomize with a hardware platform
+# pinned (e.g. JAX_PLATFORMS=axon); backends init lazily, so flipping the
+# live config before the first jax.devices() call still lands on CPU.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

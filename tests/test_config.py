@@ -57,3 +57,14 @@ def test_per_config():
 def test_test_mode_defaults_model_file():
     opt = build_options(config=1, mode=2)
     assert opt.model_file == opt.model_name
+
+
+def test_selector_overrides_recompute_defaults():
+    # agent_type override must pull DDPG hyperparameter defaults
+    o = build_options(config=1, agent_type="ddpg")
+    assert o.agent_params.batch_size == 64
+    assert o.agent_params.clip_grad == 40.0
+    # memory_type override must flip the PER flag
+    assert build_options(config=0, memory_type="prioritized").memory_params.enable_per
+    # model_type override must re-derive the state dtype family
+    assert build_options(config=0, model_type="dqn-mlp").memory_params.state_dtype == "float32"

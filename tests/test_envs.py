@@ -1,0 +1,136 @@
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import build_options
+from pytorch_distributed_tpu.envs import (
+    CartPoleEnv, FakeChainEnv, PendulumEnv, PongSimEnv,
+)
+
+
+def _params(config, **kw):
+    return build_options(config=config, **kw).env_params
+
+
+def test_fake_chain_optimal_rollout():
+    env = FakeChainEnv(_params(1))
+    obs = env.reset()
+    assert obs.shape == (8,) and obs[0] == 1.0
+    total, steps = 0.0, 0
+    terminal = False
+    while not terminal:
+        obs, r, terminal, _ = env.step(1)
+        total += r
+        steps += 1
+    assert steps == 7 and total == 1.0
+
+
+def test_fake_chain_optimal_q_consistency():
+    env = FakeChainEnv(_params(1))
+    q = env.optimal_q(0.9)
+    # Q(L-2, right) = immediate terminal reward
+    assert q[-1, 1] == 1.0
+    # bellman: Q(i, right) = gamma * max Q(i+1)
+    for i in range(env.length - 2):
+        assert q[i, 1] == pytest.approx(0.9 * q[i + 1].max())
+
+
+def test_cartpole_runs_and_terminates():
+    env = CartPoleEnv(_params(3))
+    obs = env.reset()
+    assert obs.shape == (4,) and obs.dtype == np.float32
+    terminal, steps = False, 0
+    while not terminal and steps < 1000:
+        obs, r, terminal, _ = env.step(steps % 2)
+        assert r == 1.0
+        steps += 1
+    assert terminal
+
+
+def test_pendulum_reward_range_and_scaling():
+    env = PendulumEnv(_params(2))
+    obs = env.reset()
+    assert obs.shape == (3,)
+    assert np.isclose(np.linalg.norm(obs[:2]), 1.0, atol=1e-5)
+    _, r, _, _ = env.step(np.array([0.5]))
+    assert -17.0 < r <= 0.0
+    # denormalize maps [-1,1] -> [-2,2]
+    assert env.action_space.denormalize(np.array([1.0]))[0] == pytest.approx(2.0)
+    assert env.action_space.denormalize(np.array([-1.0]))[0] == pytest.approx(-2.0)
+
+
+def test_pendulum_episode_length():
+    env = PendulumEnv(_params(2))
+    env.reset()
+    for i in range(200):
+        _, _, terminal, _ = env.step(np.array([0.0]))
+    assert terminal
+
+
+def test_pong_sim_observation_contract():
+    env = PongSimEnv(_params(4))
+    obs = env.reset()
+    assert obs.shape == (4, 84, 84)
+    assert obs.dtype == np.uint8
+    assert env.norm_val == 255.0
+    assert env.action_space.n == 6
+    obs, r, terminal, info = env.step(2)
+    assert obs.shape == (4, 84, 84)
+    assert "score" in info
+
+
+def test_pong_sim_frame_stack_rolls():
+    env = PongSimEnv(_params(4))
+    obs0 = env.reset()
+    obs1, *_ = env.step(0)
+    # newest frame enters at the end of the stack
+    np.testing.assert_array_equal(obs1[:-1][-1], obs0[-1])
+
+
+def test_pong_sim_scoring_happens():
+    env = PongSimEnv(_params(4))
+    env.reset()
+    rng = np.random.default_rng(0)
+    rewards = []
+    for _ in range(3000):
+        _, r, terminal, _ = env.step(int(rng.integers(6)))
+        rewards.append(r)
+        if terminal:
+            break
+    # with random play the tracker opponent should score on us
+    assert min(rewards) == -1.0
+
+
+def test_pong_sim_tracker_policy_scores_points():
+    # A perfect tracking policy should at least sometimes score
+    env = PongSimEnv(_params(4))
+    env.reset()
+    got = 0.0
+    for _ in range(5000):
+        act = 2 if env.ball_y < env.player_y else 3
+        _, r, terminal, _ = env.step(act)
+        got += max(r, 0.0)
+        if terminal:
+            break
+    assert got > 0
+
+
+def test_early_stop_truncates():
+    p = _params(1)
+    p.early_stop = 5
+    env = FakeChainEnv(p)
+    env.reset()
+    for _ in range(5):
+        _, _, terminal, info = env.step(0)  # always-left never terminates naturally
+    assert terminal and info.get("truncated")
+
+
+def test_per_process_seed_diversity():
+    a = PongSimEnv(_params(4), process_ind=0)
+    b = PongSimEnv(_params(4), process_ind=1)
+    assert a.seed != b.seed
+
+
+def test_atari_gated_import_error():
+    with pytest.raises(ImportError):
+        from pytorch_distributed_tpu.envs.atari import AtariEnv
+        AtariEnv(_params(0))

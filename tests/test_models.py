@@ -1,0 +1,113 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.models import (
+    DdpgMlpModel, DqnCnnModel, DqnMlpModel,
+    apex_epsilon, build_ddpg_act, build_epsilon_greedy_act,
+)
+from pytorch_distributed_tpu.models.policies import build_greedy_act
+
+
+def test_dqn_cnn_shapes_and_dtype():
+    model = DqnCnnModel(action_space=6)
+    x = DqnCnnModel.example_input(batch=2)
+    params = model.init(jax.random.PRNGKey(0), x)
+    q = model.apply(params, x)
+    assert q.shape == (2, 6)
+    assert q.dtype == jnp.float32
+
+
+def test_dqn_cnn_conv_trunk_size():
+    # Nature trunk on 84x84 flattens to 7*7*64 = 3136 before the 512 dense
+    model = DqnCnnModel(action_space=4)
+    params = model.init(jax.random.PRNGKey(0), DqnCnnModel.example_input())
+    dense_kernel = params["params"]["Dense_0"]["kernel"]
+    assert dense_kernel.shape == (3136, 512)
+
+
+def test_dqn_cnn_normalisation():
+    # all-zero and all-255 inputs must produce different Q values, and the
+    # input is normalised so activations stay sane
+    model = DqnCnnModel(action_space=4)
+    x0 = jnp.zeros((1, 4, 84, 84), dtype=jnp.uint8)
+    x1 = jnp.full((1, 4, 84, 84), 255, dtype=jnp.uint8)
+    params = model.init(jax.random.PRNGKey(0), x0)
+    q0, q1 = model.apply(params, x0), model.apply(params, x1)
+    assert not np.allclose(q0, q1)
+    assert np.all(np.abs(q1) < 100)
+
+
+def test_dqn_mlp_shapes():
+    model = DqnMlpModel(action_space=2)
+    x = jnp.zeros((5, 8), dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    q = model.apply(params, x)
+    assert q.shape == (5, 2)
+
+
+def test_ddpg_model_paths():
+    model = DdpgMlpModel(action_dim=1)
+    x = jnp.zeros((3, 3), dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    a, q = model.apply(params, x)
+    assert a.shape == (3, 1) and q.shape == (3,)
+    assert np.all(np.abs(a) <= 1.0)
+    a2 = model.apply(params, x, method=model.forward_actor)
+    np.testing.assert_allclose(a, a2)
+    q2 = model.apply(params, x, a2, method=model.forward_critic)
+    np.testing.assert_allclose(q, q2, rtol=1e-6)
+
+
+def test_ddpg_out_init_small():
+    model = DdpgMlpModel(action_dim=2)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 5)))
+    out_k = params["params"]["actor_out"]["kernel"]
+    assert np.max(np.abs(out_k)) <= 3e-3
+
+
+def test_apex_epsilon_schedule():
+    # reference dqn_actor.py:33-36: actor 0 gets eps**1, last actor eps**8
+    assert apex_epsilon(0, 8) == pytest.approx(0.4)
+    assert apex_epsilon(7, 8) == pytest.approx(0.4 ** 8)
+    assert apex_epsilon(0, 1) == 0.1
+    eps = [apex_epsilon(i, 8) for i in range(8)]
+    assert eps == sorted(eps, reverse=True)
+
+
+def test_epsilon_greedy_act():
+    model = DqnMlpModel(action_space=3)
+    x = jnp.zeros((4, 6))
+    params = model.init(jax.random.PRNGKey(0), x)
+    act = build_epsilon_greedy_act(model.apply)
+    a, q_sel, q_max = act(params, x, jax.random.PRNGKey(1), 0.0)
+    assert a.shape == (4,)
+    # greedy: selected q == max q
+    np.testing.assert_allclose(q_sel, q_max)
+    # eps=1: all random; over many keys all actions appear
+    actions = set()
+    for i in range(20):
+        a, _, _ = act(params, x, jax.random.PRNGKey(i), 1.0)
+        actions.update(np.asarray(a).tolist())
+    assert actions == {0, 1, 2}
+
+
+def test_greedy_act():
+    model = DqnMlpModel(action_space=3)
+    x = jnp.ones((2, 6))
+    params = model.init(jax.random.PRNGKey(0), x)
+    act = build_greedy_act(model.apply)
+    a, qm = act(params, x)
+    q = model.apply(params, x)
+    np.testing.assert_array_equal(a, np.argmax(q, axis=-1))
+
+
+def test_ddpg_act():
+    model = DdpgMlpModel(action_dim=2)
+    x = jnp.zeros((3, 4))
+    params = model.init(jax.random.PRNGKey(0), x)
+    act = build_ddpg_act(
+        lambda p, o: model.apply(p, o, method=model.forward_actor))
+    a = act(params, x)
+    assert a.shape == (3, 2)

@@ -1,0 +1,85 @@
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.ops.nstep import NStepAssembler, nstep_from_episode
+
+
+def _run_assembler(nstep, gamma, rewards, terminal=True):
+    """Feed a synthetic episode; states are scalars 0..T."""
+    T = len(rewards)
+    asm = NStepAssembler(nstep, gamma)
+    out = []
+    for t in range(T):
+        out.extend(asm.feed(
+            state0=np.float32(t), action=np.int32(t % 2),
+            reward=float(rewards[t]), state1=np.float32(t + 1),
+            terminal=(t == T - 1) and terminal,
+            truncated=(t == T - 1) and not terminal))
+    return out
+
+
+@pytest.mark.parametrize("nstep", [1, 3, 5])
+@pytest.mark.parametrize("T", [1, 2, 5, 9])
+def test_assembler_matches_vectorized(nstep, T):
+    gamma = 0.9
+    rng = np.random.default_rng(T * 10 + nstep)
+    rewards = rng.normal(size=T)
+    states = np.arange(T + 1, dtype=np.float32)
+    actions = (np.arange(T) % 2).astype(np.int32)
+
+    got = _run_assembler(nstep, gamma, rewards)
+    want = nstep_from_episode(states, actions, rewards, nstep, gamma)
+
+    assert len(got) == T
+    for t, tr in enumerate(got):
+        assert tr.state0 == want.state0[t]
+        assert tr.action == want.action[t]
+        np.testing.assert_allclose(tr.reward, want.reward[t], rtol=1e-5)
+        np.testing.assert_allclose(tr.gamma_n, want.gamma_n[t], rtol=1e-6)
+        assert tr.state1 == want.state1[t]
+        assert tr.terminal1 == want.terminal1[t]
+
+
+def test_nstep_reward_sum_by_hand():
+    # T=4, nstep=3, gamma=0.5, rewards 1,2,3,4
+    out = _run_assembler(3, 0.5, [1, 2, 3, 4])
+    # t=0: R = 1 + 0.5*2 + 0.25*3 = 2.75, m=3, s1=3, term=0
+    assert out[0].reward == pytest.approx(2.75)
+    assert out[0].gamma_n == pytest.approx(0.125)
+    assert out[0].state1 == 3.0 and out[0].terminal1 == 0.0
+    # t=1: R = 2 + 0.5*3 + 0.25*4 = 4.5, m=3, ends at T -> terminal
+    assert out[1].reward == pytest.approx(4.5)
+    assert out[1].terminal1 == 1.0
+    # t=2 (flush, m=2): R = 3 + 0.5*4 = 5, gamma_n = 0.25
+    assert out[2].reward == pytest.approx(5.0)
+    assert out[2].gamma_n == pytest.approx(0.25)
+    assert out[2].terminal1 == 1.0
+    # t=3 (flush, m=1): R = 4
+    assert out[3].reward == pytest.approx(4.0)
+    assert out[3].gamma_n == pytest.approx(0.5)
+
+
+def test_truncation_bootstraps():
+    out = _run_assembler(3, 0.9, [1, 1, 1, 1], terminal=False)
+    assert all(tr.terminal1 == 0.0 for tr in out)
+    assert len(out) == 4
+
+
+def test_emission_timing_steady_state():
+    asm = NStepAssembler(3, 0.9)
+    emitted = []
+    for t in range(6):
+        emitted.append(len(asm.feed(t, 0, 1.0, t + 1, terminal=False)))
+    # first two feeds emit nothing, then one per feed
+    assert emitted == [0, 0, 1, 1, 1, 1]
+    assert asm.pending == 2
+    assert len(asm.flush()) == 2
+    assert asm.pending == 0
+
+
+def test_single_step_episode():
+    out = _run_assembler(5, 0.9, [7.0])
+    assert len(out) == 1
+    assert out[0].reward == pytest.approx(7.0)
+    assert out[0].gamma_n == pytest.approx(0.9)
+    assert out[0].terminal1 == 1.0

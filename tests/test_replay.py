@@ -1,0 +1,131 @@
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.memory import PrioritizedReplay, SharedReplay
+from pytorch_distributed_tpu.utils.experience import Transition
+
+
+def _tr(i, state_shape=(4,), terminal=0.0):
+    return Transition(
+        state0=np.full(state_shape, i, dtype=np.float32),
+        action=np.int32(i % 2),
+        reward=np.float32(i),
+        gamma_n=np.float32(0.99),
+        state1=np.full(state_shape, i + 1, dtype=np.float32),
+        terminal1=np.float32(terminal),
+    )
+
+
+def test_shared_replay_feed_sample_roundtrip():
+    m = SharedReplay(capacity=10, state_shape=(4,), state_dtype=np.float32)
+    assert m.size == 0
+    for i in range(5):
+        m.feed(_tr(i))
+    assert m.size == 5
+    b = m.sample(32, np.random.default_rng(0))
+    assert b.state0.shape == (32, 4)
+    # sampled rows are consistent: state1 == state0 + 1, reward == state0[...,0]
+    np.testing.assert_allclose(b.state1[:, 0], b.state0[:, 0] + 1)
+    np.testing.assert_allclose(b.reward, b.state0[:, 0])
+    assert np.all(b.weight == 1.0)
+
+
+def test_shared_replay_circular_overwrite():
+    m = SharedReplay(capacity=4, state_shape=(2,), state_dtype=np.float32)
+    for i in range(6):
+        m.feed(_tr(i, state_shape=(2,)))
+    assert m.size == 4  # full
+    b = m.sample(64, np.random.default_rng(0))
+    # slots 0,1 were overwritten by 4,5: values present are 2..5
+    present = set(np.unique(b.reward).tolist())
+    assert present <= {2.0, 3.0, 4.0, 5.0}
+    assert m.total_feeds == 6
+
+
+def test_shared_replay_uint8_states():
+    m = SharedReplay(capacity=8, state_shape=(4, 84, 84), state_dtype=np.uint8)
+    t = Transition(
+        state0=np.full((4, 84, 84), 200, dtype=np.uint8),
+        action=np.int32(3), reward=np.float32(1.0),
+        gamma_n=np.float32(0.95),
+        state1=np.full((4, 84, 84), 100, dtype=np.uint8),
+        terminal1=np.float32(1.0))
+    m.feed(t)
+    b = m.sample(2, np.random.default_rng(1))
+    assert b.state0.dtype == np.uint8
+    assert b.state0[0, 0, 0, 0] == 200
+
+
+def _writer(mem, start, n):
+    for i in range(start, start + n):
+        mem.feed(_tr(i, state_shape=(2,)))
+
+
+def test_shared_replay_cross_process():
+    # actors in child processes write; parent samples — the reference's
+    # core topology (shared_memory.py shared pages across spawn)
+    ctx = mp.get_context("spawn")
+    m = SharedReplay(capacity=64, state_shape=(2,), state_dtype=np.float32)
+    ps = [ctx.Process(target=_writer, args=(m, k * 10, 10)) for k in range(3)]
+    for p in ps:
+        p.start()
+    for p in ps:
+        p.join()
+    assert m.size == 30
+    b = m.sample(100, np.random.default_rng(0))
+    np.testing.assert_allclose(b.state1[:, 0], b.state0[:, 0] + 1)
+
+
+def test_prioritized_replay_weights_and_sampling():
+    m = PrioritizedReplay(capacity=16, state_shape=(2,),
+                          state_dtype=np.float32, priority_exponent=1.0,
+                          importance_weight=1.0)
+    for i in range(4):
+        m.feed(_tr(i, state_shape=(2,)), priority=float(i + 1))
+    rng = np.random.default_rng(0)
+    counts = np.zeros(4)
+    for _ in range(300):
+        b = m.sample(16, rng)
+        np.add.at(counts, b.index, 1)
+    freq = counts / counts.sum()
+    # priorities (after +eps) roughly 1,2,3,4 -> freq ~ i/10
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.3, 0.4], atol=0.02)
+    # beta=1 exact IS weights: w_i ~ (N p_i)^-1 normalised by max
+    b = m.sample(256, rng)
+    w_for_min = b.weight[b.index == 0]
+    assert w_for_min.size and np.allclose(w_for_min, 1.0)  # rarest has max weight
+    w_for_max = b.weight[b.index == 3]
+    assert np.allclose(w_for_max, 0.25, atol=1e-5)
+
+
+def test_prioritized_update_priorities():
+    m = PrioritizedReplay(capacity=8, state_shape=(2,),
+                          state_dtype=np.float32, priority_exponent=1.0)
+    for i in range(8):
+        m.feed(_tr(i, state_shape=(2,)))
+    m.update_priorities(np.array([0, 1, 2, 3, 4, 5, 6]), np.zeros(7))
+    rng = np.random.default_rng(0)
+    b = m.sample(64, rng)
+    # slot 7 keeps max priority; others ~eps -> overwhelmingly sample 7
+    assert np.mean(b.index == 7) > 0.95
+
+
+def test_prioritized_new_items_get_max_priority():
+    m = PrioritizedReplay(capacity=8, state_shape=(2,),
+                          state_dtype=np.float32)
+    m.feed(_tr(0, state_shape=(2,)), priority=10.0)
+    m.feed(_tr(1, state_shape=(2,)))  # no priority -> max so far
+    p0, p1 = m.sum_tree.get(np.array([0, 1]))
+    assert p1 >= p0 * 0.99
+
+
+def test_prioritized_circular():
+    m = PrioritizedReplay(capacity=4, state_shape=(2,), state_dtype=np.float32)
+    for i in range(7):
+        m.feed(_tr(i, state_shape=(2,)))
+    assert m.size == 4
+    b = m.sample(64, np.random.default_rng(0))
+    present = set(np.unique(b.reward).tolist())
+    assert present <= {3.0, 4.0, 5.0, 6.0}

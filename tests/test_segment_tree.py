@@ -60,3 +60,19 @@ def test_min_tree():
     assert t.min == 1.0
     t.set(np.array([9]), np.array([0.25]))
     assert t.min == 0.25
+
+
+def test_empty_batch_operations_are_noops():
+    t = SumTree(10)
+    t.set(np.arange(3), np.ones(3))
+    t.set(np.array([], dtype=np.int64), np.array([]))
+    assert t.total == 3.0
+    assert t.find(np.array([])).size == 0
+    m = MinTree(10)
+    m.set(np.array([], dtype=np.int64), np.array([]))
+
+
+def test_min_tree_rejects_out_of_range():
+    m = MinTree(10)
+    with pytest.raises(AssertionError):
+        m.set(np.array([12]), np.array([0.01]))
