@@ -1,0 +1,291 @@
+"""Learner update steps as pure jitted functions.
+
+Functional re-design of the reference learner hot loops
+(reference core/single_processes/dqn_learner.py:50-95 and
+ddpg_learner.py:50-106): where the reference mutates a shared CUDA model
+with torch autograd + Adam in an OS process, here each update is a pure
+``(TrainState, Batch, key) -> (TrainState, metrics)`` XLA program — the
+whole step (forward, backward, optimizer, target update) compiles into one
+fused computation that the parallel layer can shard over a device mesh with
+gradient all-reduce over ICI (parallel/learner.py).
+
+Semantics parity (each cited):
+- n-step target ``r + gamma_n * bootstrap(s1) * (1 - terminal)`` with the
+  *stored per-sample* effective discount gamma_n
+  (reference dqn_learner.py:73-74);
+- optional double-DQN action selection by the online net
+  (reference dqn_learner.py:67-71, off by default utils/options.py:139);
+- MSE value criterion (reference utils/options.py:114) — Huber available;
+- gradient clip by value (torch ``clip_grad_value_``,
+  reference dqn_learner.py:80-82; inf for DQN, 40 for DDPG);
+- target update: hard every N steps for DQN, soft tau for DDPG
+  (reference utils/helpers.py:19-25);
+- DDPG: policy loss ``-Q(s, pi(s)).mean()`` + critic TD loss
+  (reference ddpg_learner.py:66-86).  The reference couples both losses
+  through one Adam step so policy-loss gradients also hit the critic
+  (ddpg_learner.py:62-91, SURVEY.md "known quirks"); ``coupled=True``
+  reproduces that, the default decouples per-net optimizers.
+
+PER additions beyond the reference (its TODO): importance weights multiply
+the per-sample TD loss, and |TD| errors are returned for priority
+write-back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from pytorch_distributed_tpu.utils.experience import Batch
+from pytorch_distributed_tpu.utils.helpers import global_norm, update_target
+
+PyTree = Any
+
+
+class TrainState(NamedTuple):
+    params: PyTree
+    target_params: PyTree
+    opt_state: PyTree
+    step: jnp.ndarray  # int32 learner step (the global clock's source)
+
+
+def init_train_state(params: PyTree,
+                     tx: optax.GradientTransformation) -> TrainState:
+    """Build a fresh TrainState with the target net hard-synced to the
+    online net (reference dqn_learner.py:21-35 syncs at start).  The target
+    tree is an independent buffer copy — aliasing ``TrainState(params,
+    params, ...)`` breaks donation (XLA rejects donating one buffer twice).
+    """
+    target = jax.tree_util.tree_map(jnp.array, params)
+    return TrainState(params, target, tx.init(params), jnp.asarray(0))
+
+
+def make_optimizer(lr: float, clip_grad: float = float("inf"),
+                   weight_decay: float = 0.0) -> optax.GradientTransformation:
+    """Adam with optional by-value grad clipping, matching the reference's
+    Adam + clip_grad_value_ pairing (reference dqn_learner.py:37-39,80-82)."""
+    chain = []
+    if clip_grad != float("inf"):
+        chain.append(optax.clip(clip_grad))  # by-value, like clip_grad_value_
+    if weight_decay > 0.0:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    chain.append(optax.adam(lr))
+    return optax.chain(*chain)
+
+
+def _value_loss(pred: jnp.ndarray, target: jnp.ndarray, weight: jnp.ndarray,
+                huber: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    td = pred - jax.lax.stop_gradient(target)
+    if huber:
+        per = optax.huber_loss(pred, jax.lax.stop_gradient(target), delta=1.0)
+    else:
+        # plain squared error, matching the reference's nn.MSELoss
+        # (reference utils/options.py:114) — no 1/2 factor, so gradient
+        # magnitudes match the reference under identical learning rates
+        per = jnp.square(td)
+    return jnp.mean(weight * per), jnp.abs(td)
+
+
+def build_dqn_train_step(
+    apply_fn: Callable,
+    tx: optax.GradientTransformation,
+    *,
+    enable_double: bool = False,
+    target_model_update: float = 250,
+    huber: bool = False,
+    axis_name: str | None = None,
+) -> Callable[[TrainState, Batch],
+              Tuple[TrainState, Dict[str, jnp.ndarray], jnp.ndarray]]:
+    """Returns the DQN update step ``(state, batch) -> (state, metrics,
+    td_abs)`` (reference dqn_learner.py:55-95 as one XLA program); ``td_abs``
+    feeds PER priority write-back."""
+
+    def step(state: TrainState, batch: Batch):
+        def loss_fn(params):
+            q = apply_fn(params, batch.state0)                       # (B, A)
+            a = batch.action.astype(jnp.int32).reshape(-1, 1)
+            q_sel = jnp.take_along_axis(q, a, axis=1)[:, 0]
+            q_next = apply_fn(state.target_params, batch.state1)     # (B, A)
+            if enable_double:
+                a_next = jnp.argmax(apply_fn(params, batch.state1), axis=-1)
+                bootstrap = jnp.take_along_axis(
+                    q_next, a_next[:, None], axis=1)[:, 0]
+            else:
+                bootstrap = jnp.max(q_next, axis=-1)
+            target = (batch.reward
+                      + batch.gamma_n * bootstrap * (1.0 - batch.terminal1))
+            loss, td_abs = _value_loss(q_sel, target, batch.weight, huber)
+            return loss, (td_abs, jnp.mean(jnp.max(q, axis=-1)))
+
+        (loss, (td_abs, q_mean)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        # data-parallel: mean grads across the mesh's dp axis if present
+        grads = _pmean(grads, axis_name)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_step = state.step + 1
+        target_params = update_target(state.target_params, params, new_step,
+                                      target_model_update)
+        metrics = {
+            "learner/critic_loss": loss,
+            "learner/q_mean": q_mean,
+            "learner/grad_norm": global_norm(grads),
+        }
+        return (TrainState(params, target_params, opt_state, new_step),
+                metrics, td_abs)
+
+    return step
+
+
+def build_ddpg_train_step(
+    actor_apply_fn: Callable,
+    critic_apply_fn: Callable,
+    actor_tx: optax.GradientTransformation,
+    critic_tx: optax.GradientTransformation,
+    *,
+    target_model_update: float = 1e-3,
+    huber: bool = False,
+    axis_name: str | None = None,
+) -> Callable:
+    """Decoupled DDPG update: separate critic and actor gradient steps with
+    per-net optimizers (textbook DDPG; see module docstring re the
+    reference's coupled variant).
+
+    ``TrainState.params``/``opt_state`` are dicts {'actor':..., 'critic':...}
+    over the single DdpgMlpModel param tree split by submodule prefix — see
+    ``split_ddpg_params``/``merge_ddpg_params``.
+    """
+
+    def step(state: TrainState, batch: Batch):
+        params = state.params
+        target = state.target_params
+
+        # ---- critic update (reference ddpg_learner.py:76-86) ----
+        target_full = merge_ddpg_params(target["actor"], target["critic"])
+
+        def critic_loss_fn(critic_params):
+            full = merge_ddpg_params(params["actor"], critic_params)
+            q = critic_apply_fn(full, batch.state0, batch.action)
+            a_next = actor_apply_fn(target_full, batch.state1)
+            q_next = critic_apply_fn(target_full, batch.state1, a_next)
+            tgt = (batch.reward
+                   + batch.gamma_n * q_next * (1.0 - batch.terminal1))
+            return _value_loss(q, tgt, batch.weight, huber)
+
+        (critic_loss, td_abs), critic_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True)(params["critic"])
+        critic_grads = _pmean(critic_grads, axis_name)
+        critic_updates, critic_opt = critic_tx.update(
+            critic_grads, state.opt_state["critic"], params["critic"])
+        new_critic = optax.apply_updates(params["critic"], critic_updates)
+
+        # ---- actor update (reference ddpg_learner.py:66-74) ----
+        def actor_loss_fn(actor_params):
+            full = merge_ddpg_params(actor_params, new_critic)
+            a = actor_apply_fn(full, batch.state0)
+            q = critic_apply_fn(full, batch.state0, a)
+            return -jnp.mean(q)
+
+        actor_loss, actor_grads = jax.value_and_grad(actor_loss_fn)(
+            params["actor"])
+        actor_grads = _pmean(actor_grads, axis_name)
+        actor_updates, actor_opt = actor_tx.update(
+            actor_grads, state.opt_state["actor"], params["actor"])
+        new_actor = optax.apply_updates(params["actor"], actor_updates)
+
+        new_params = {"actor": new_actor, "critic": new_critic}
+        new_step = state.step + 1
+        # soft target every step (reference ddpg_learner.py:95, tau=1e-3)
+        new_target = update_target(target, new_params, new_step,
+                                   target_model_update)
+        metrics = {
+            "learner/critic_loss": critic_loss,
+            "learner/actor_loss": actor_loss,
+            "learner/grad_norm": global_norm(critic_grads),
+        }
+        return (TrainState(new_params, new_target,
+                           {"actor": actor_opt, "critic": critic_opt},
+                           new_step),
+                metrics, td_abs)
+
+    return step
+
+
+def build_ddpg_train_step_coupled(
+    actor_apply_fn: Callable,
+    critic_apply_fn: Callable,
+    tx: optax.GradientTransformation,
+    *,
+    target_model_update: float = 1e-3,
+    huber: bool = False,
+    axis_name: str | None = None,
+) -> Callable:
+    """Reference-faithful coupled DDPG update: one optimizer over the full
+    param tree, one gradient step of ``policy_loss + critic_loss`` — so the
+    policy-loss gradient also deposits into critic params, exactly the
+    behaviour of the reference's single zero_grad / double backward /
+    single Adam step (reference ddpg_learner.py:62-91).  TrainState.params
+    is the *merged* tree here."""
+
+    def step(state: TrainState, batch: Batch):
+        def loss_fn(full):
+            # critic TD loss (reference ddpg_learner.py:76-86)
+            q = critic_apply_fn(full, batch.state0, batch.action)
+            a_next = actor_apply_fn(state.target_params, batch.state1)
+            q_next = critic_apply_fn(state.target_params, batch.state1, a_next)
+            tgt = (batch.reward
+                   + batch.gamma_n * q_next * (1.0 - batch.terminal1))
+            critic_loss, td_abs = _value_loss(q, tgt, batch.weight, huber)
+            # policy loss (reference ddpg_learner.py:66-74)
+            a = actor_apply_fn(full, batch.state0)
+            actor_loss = -jnp.mean(critic_apply_fn(full, batch.state0, a))
+            return critic_loss + actor_loss, (critic_loss, actor_loss, td_abs)
+
+        (_, (critic_loss, actor_loss, td_abs)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        grads = _pmean(grads, axis_name)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_step = state.step + 1
+        new_target = update_target(state.target_params, params, new_step,
+                                   target_model_update)
+        metrics = {
+            "learner/critic_loss": critic_loss,
+            "learner/actor_loss": actor_loss,
+            "learner/grad_norm": global_norm(grads),
+        }
+        return (TrainState(params, new_target, opt_state, new_step),
+                metrics, td_abs)
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# DDPG param-tree surgery: the model is one Flax module whose top-level
+# submodules are actor_* / critic_* (models/ddpg_mlp.py setup()); split so
+# each optimizer owns exactly its net.
+# ---------------------------------------------------------------------------
+
+def split_ddpg_params(full: PyTree) -> Dict[str, PyTree]:
+    inner = full["params"]
+    actor = {k: v for k, v in inner.items() if k.startswith("actor")}
+    critic = {k: v for k, v in inner.items() if k.startswith("critic")}
+    assert actor and critic, f"unexpected DDPG param layout: {list(inner)}"
+    return {"actor": {"params": actor}, "critic": {"params": critic}}
+
+
+def merge_ddpg_params(actor: PyTree, critic: PyTree) -> PyTree:
+    return {"params": {**actor["params"], **critic["params"]}}
+
+
+def _pmean(tree: PyTree, axis_name: str | None) -> PyTree:
+    """Mean-reduce gradients across a mesh axis (the ICI all-reduce).  Only
+    needed under shard_map, where collectives are explicit; under plain jit
+    with sharded batch inputs XLA inserts the all-reduce itself, and
+    axis_name stays None."""
+    if axis_name is None:
+        return tree
+    return jax.lax.pmean(tree, axis_name=axis_name)

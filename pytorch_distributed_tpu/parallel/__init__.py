@@ -1,0 +1,4 @@
+from pytorch_distributed_tpu.parallel.mesh import make_mesh, batch_sharding, replicated
+from pytorch_distributed_tpu.parallel.learner import ShardedLearner
+
+__all__ = ["make_mesh", "batch_sharding", "replicated", "ShardedLearner"]

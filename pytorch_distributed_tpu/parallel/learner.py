@@ -1,0 +1,92 @@
+"""Sharded learner: the train step compiled over the device mesh.
+
+This is the TPU-native answer to the reference's "multi-learner hook" —
+where ``num_learners > 1`` in the reference would race unsynchronized Adam
+steps on one shared CUDA model (reference main.py:83-94, SURVEY.md "known
+quirks"), here scaling the learner means *one* jit-compiled update whose
+batch is sharded across the mesh's dp axis; XLA partitions the forward/
+backward per chip and inserts the gradient all-reduce over ICI.  Params,
+optimizer state and the target net are replicated; donated so the whole
+TrainState updates in place in HBM.
+
+Usage:
+    learner = ShardedLearner(step_fn, mesh)          # step_fn from ops.losses
+    state = learner.place(state)                     # replicate onto mesh
+    state, metrics, td = learner.step(state, batch)  # batch: host np arrays
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from pytorch_distributed_tpu.parallel.mesh import batch_sharding, replicated
+from pytorch_distributed_tpu.utils.experience import Batch
+
+
+class ShardedLearner:
+    def __init__(self, step_fn: Callable, mesh: Optional[jax.sharding.Mesh],
+                 donate: bool = True):
+        self.mesh = mesh
+        self._serialize_collectives = (
+            mesh is not None
+            and mesh.devices.flat[0].platform == "cpu"
+            and mesh.size > 1)
+        if mesh is None:
+            self._step = jax.jit(step_fn,
+                                 donate_argnums=(0,) if donate else ())
+            self._batch_sharding = None
+        else:
+            self._batch_sharding = batch_sharding(mesh)
+            self._state_sharding = replicated(mesh)
+            # Replicated state + dp-sharded batch; XLA lowers the gradient
+            # reduction to an ICI all-reduce automatically.
+            self._step = jax.jit(
+                step_fn,
+                in_shardings=(self._state_sharding, self._batch_sharding),
+                out_shardings=(self._state_sharding, self._state_sharding,
+                               self._batch_sharding),
+                donate_argnums=(0,) if donate else (),
+            )
+
+    def place(self, state: Any) -> Any:
+        """Move a host-initialised TrainState onto the mesh (replicated)."""
+        if self.mesh is None:
+            return jax.device_put(state)
+        return jax.device_put(state, self._state_sharding)
+
+    def shard_batch(self, batch: Batch) -> Batch:
+        if self._batch_sharding is None:
+            return batch
+        dp = self.mesh.shape["dp"]
+        bsz = batch.reward.shape[0]
+        if bsz % dp != 0:
+            raise ValueError(
+                f"batch_size {bsz} must be divisible by the mesh dp axis "
+                f"({dp}) for data-parallel sharding")
+        return jax.device_put(batch, self._batch_sharding)
+
+    def step(self, state, batch: Batch):
+        out = self._step(state, self.shard_batch(batch))
+        if self._serialize_collectives:
+            # XLA's CPU collective thunks rendezvous on a shared thread
+            # pool; several queued multi-device programs can starve each
+            # other into the 40 s rendezvous abort.  Blocking per step only
+            # on the CPU simulation keeps the 8-virtual-device test path
+            # deterministic; TPU keeps full async dispatch.
+            jax.block_until_ready(out[0])
+        return out
+
+    def host_params(self, state) -> Any:
+        """Fetch the current online params to host memory for publication to
+        actors (the explicit versioned-publication replacing the reference's
+        implicit shared-CUDA visibility, SURVEY.md §7 "hard parts").
+
+        Actor-side inference must run on published host copies — NOT on the
+        mesh-sharded TrainState — both because actors live in other
+        processes and because issuing dependent multi-device programs
+        against in-flight collective state can deadlock the CPU backend's
+        rendezvous (and serialises the TPU pipeline).
+        """
+        return jax.device_get(state.params)

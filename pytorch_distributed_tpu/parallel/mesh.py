@@ -1,0 +1,59 @@
+"""Device-mesh construction and sharding vocabulary.
+
+No reference equivalent: the reference is single-GPU with process-level
+actor fan-out only (SURVEY.md §2 "parallelism strategies").  This is the
+TPU-native distribution backbone: a logical ``jax.sharding.Mesh`` over all
+chips with two axes —
+
+- ``dp`` (data parallel): carries the learner batch; gradients are
+  all-reduced across it over ICI (XLA inserts the collective when the batch
+  is dp-sharded and params are replicated);
+- ``mp`` (model parallel): reserved for tensor-sharded layers on models wide
+  enough to pay for it; size 1 in all current configs.
+
+Multi-host pods: call ``jax.distributed.initialize`` first
+(``init_multihost``), then the same mesh code spans all hosts' devices —
+DCN between hosts, ICI within.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp_size: int = -1, mp_size: int = 1,
+              devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp_size == -1:
+        assert n % mp_size == 0, f"{n} devices not divisible by mp={mp_size}"
+        dp_size = n // mp_size
+    assert dp_size * mp_size <= n, (
+        f"mesh {dp_size}x{mp_size} needs more than {n} devices")
+    grid = np.array(devices[: dp_size * mp_size]).reshape(dp_size, mp_size)
+    return Mesh(grid, ("dp", "mp"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis (batch) sharding over dp."""
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: int = 1, process_id: int = 0) -> None:
+    """Bring up the DCN layer for a multi-host pod
+    (jax.distributed; the TPU equivalent of a NCCL/MPI world init)."""
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
