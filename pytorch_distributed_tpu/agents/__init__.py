@@ -1,0 +1,19 @@
+"""Worker-process layer.
+
+TPU-native re-design of the reference's ``core/single_processes/`` package:
+the same five process roles per agent family — actor, learner, evaluator,
+tester, logger (reference utils/factory.py:22-31) — but communicating by
+explicit message passing (versioned parameter publication + shared/queued
+replay feeds + counter structs) instead of implicitly shared CUDA storage
+(SURVEY.md §2 "distributed communication backend").
+"""
+
+from pytorch_distributed_tpu.agents.clocks import (
+    ActorStats, EvaluatorStats, GlobalClock, LearnerStats,
+)
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+
+__all__ = [
+    "GlobalClock", "ActorStats", "LearnerStats", "EvaluatorStats",
+    "ParamStore",
+]

@@ -1,0 +1,199 @@
+"""Actor processes: asynchronous experience collection.
+
+Re-design of reference core/single_processes/dqn_actor.py and
+ddpg_actor.py.  Same topology — N independent rollout workers, each with a
+full local model replica and its own env, diversified by the Ape-X
+exploration schedule and per-process seed — with the reference's implicit
+shared-CUDA weight pulls replaced by versioned ``ParamStore`` fetches and
+its inline deque bookkeeping replaced by the unit-tested ``NStepAssembler``.
+
+Cadences mirror the reference: weight re-sync every ``actor_sync_freq``
+local steps (reference dqn_actor.py:176-178), stats pushed every
+``actor_freq`` steps (reference :180-192), one global actor-step counter
+increment per env step under its lock (reference :166-167), loop until the
+global learner clock reaches ``steps`` (reference :62).
+
+Inference is a jitted host-side forward (the actor process pins JAX to CPU
+via the runtime trampoline), so per-step latency has no device round-trip —
+the answer to the reference's latency-bound batch-1 CUDA forward
+(SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from pytorch_distributed_tpu.config import Options
+from pytorch_distributed_tpu.factory import (
+    EnvSpec, build_env, build_model, ddpg_applies, init_params,
+)
+from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import (
+    ParamStore, make_flattener,
+)
+from pytorch_distributed_tpu.ops.nstep import NStepAssembler
+from pytorch_distributed_tpu.utils.random_process import (
+    OrnsteinUhlenbeckProcess,
+)
+from pytorch_distributed_tpu.utils.rngs import process_key, process_seed
+
+
+class _ActorHarness:
+    """Shared plumbing for both actor families: env/model/params setup,
+    n-step feed, stat accumulation, sync cadence."""
+
+    def __init__(self, opt: Options, spec: EnvSpec, process_ind: int,
+                 memory: Any, param_store: ParamStore, clock: GlobalClock,
+                 stats: ActorStats):
+        self.opt = opt
+        self.ap = opt.agent_params
+        self.spec = spec
+        self.process_ind = process_ind
+        self.memory = memory
+        self.param_store = param_store
+        self.clock = clock
+        self.stats = stats
+
+        self.env = build_env(opt, process_ind)
+        self.env.train()
+        self.model = build_model(opt, spec)
+        params0 = init_params(opt, spec, self.model, seed=process_seed(
+            opt.seed, "actor", process_ind))
+        _, self.unravel = make_flattener(params0)
+        # block until the learner publishes the initial weights — the
+        # explicit version of the reference's pre-spawn hard sync
+        # (reference dqn_actor.py:26-30)
+        flat, self.version = param_store.wait(0, stop=clock.stop)
+        self.params = self.unravel(flat)
+        self.assembler = NStepAssembler(self.ap.nstep, self.ap.gamma)
+
+        # local stat accumulators, flushed every actor_freq steps
+        self._acc = dict.fromkeys(ActorStats.FIELDS, 0.0)
+        self.local_step = 0
+
+    # -- cadence hooks ------------------------------------------------------
+
+    def maybe_sync(self) -> None:
+        if self.local_step % self.ap.actor_sync_freq == 0:
+            got = self.param_store.fetch(self.version)
+            if got is not None:
+                flat, self.version = got
+                self.params = self.unravel(flat)
+
+    def push_step(self, transitions) -> None:
+        for t in transitions:
+            self.memory.feed(t, None)
+        self.local_step += 1
+        self.clock.add_actor_steps(1)
+        self._acc["total_nframes"] += 1
+        if self.local_step % self.ap.actor_freq == 0:
+            self.flush_stats()
+
+    def end_episode(self, episode_steps: int, episode_reward: float,
+                    solved: bool) -> None:
+        self._acc["nepisodes"] += 1
+        self._acc["nepisodes_solved"] += float(solved)
+        self._acc["total_steps"] += episode_steps
+        self._acc["total_reward"] += episode_reward
+        if hasattr(self.memory, "flush"):
+            self.memory.flush()  # queue feeders drain at episode ends
+
+    def flush_stats(self) -> None:
+        if any(self._acc.values()):
+            self.stats.add(**self._acc)
+            self._acc = dict.fromkeys(ActorStats.FIELDS, 0.0)
+
+    def shutdown(self) -> None:
+        self.flush_stats()
+        if hasattr(self.memory, "flush"):
+            self.memory.flush()
+
+
+def run_dqn_actor(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
+                  param_store: ParamStore, clock: GlobalClock,
+                  stats: ActorStats) -> None:
+    """eps-greedy rollout worker (reference dqn_actor.py:9-192)."""
+    import jax
+
+    from pytorch_distributed_tpu.models.policies import (
+        apex_epsilon, build_epsilon_greedy_act,
+    )
+
+    h = _ActorHarness(opt, spec, process_ind, memory, param_store, clock,
+                      stats)
+    act = build_epsilon_greedy_act(h.model.apply)
+    eps = apex_epsilon(process_ind, opt.num_actors,
+                       h.ap.eps, h.ap.eps_alpha)
+    key = process_key(opt.seed, "actor", process_ind)
+
+    obs = h.env.reset()
+    episode_steps, episode_reward = 0, 0.0
+    while not clock.done(h.ap.steps):
+        key, sub = jax.random.split(key)
+        a, _q_sel, _q_max = act(h.params, obs[None], sub, eps)
+        a = int(a[0])
+        next_obs, r, terminal, info = h.env.step(a)
+        transitions = h.assembler.feed(
+            obs, a, r, next_obs, terminal,
+            truncated=bool(info.get("truncated", False)))
+        h.push_step(transitions)
+        episode_steps += 1
+        episode_reward += float(r)
+        obs = next_obs
+        if terminal:
+            h.end_episode(episode_steps, episode_reward,
+                          solved=bool(info.get("solved",
+                                               episode_reward > 0)))
+            obs = h.env.reset()
+            episode_steps, episode_reward = 0, 0.0
+        h.maybe_sync()
+    h.shutdown()
+
+
+def run_ddpg_actor(opt: Options, spec: EnvSpec, process_ind: int,
+                   memory: Any, param_store: ParamStore, clock: GlobalClock,
+                   stats: ActorStats) -> None:
+    """OU-noise rollout worker (reference ddpg_actor.py:9-172): same skeleton
+    as the DQN actor with one process-local OrnsteinUhlenbeckProcess
+    (theta/sigma from AgentParams, anneal over memory_size*100 steps —
+    reference ddpg_actor.py:34-35)."""
+    h = _ActorHarness(opt, spec, process_ind, memory, param_store, clock,
+                      stats)
+    from pytorch_distributed_tpu.models.policies import build_ddpg_act
+
+    act = build_ddpg_act(lambda p, o: h.model.apply(
+        p, o, method=h.model.forward_actor))
+    ou = OrnsteinUhlenbeckProcess(
+        size=spec.action_dim,
+        theta=h.ap.ou_theta,
+        mu=h.ap.ou_mu,
+        sigma=h.ap.ou_sigma,
+        n_steps_annealing=opt.memory_params.memory_size * 100,
+        seed=process_seed(opt.seed, "actor", process_ind) + 17,
+    )
+
+    obs = h.env.reset()
+    ou.reset_states()
+    episode_steps, episode_reward = 0, 0.0
+    while not clock.done(h.ap.steps):
+        a = np.asarray(act(h.params, obs[None]))[0]
+        a = np.clip(a + ou.sample(), -1.0, 1.0).astype(np.float32)
+        next_obs, r, terminal, info = h.env.step(a)
+        transitions = h.assembler.feed(
+            obs, a, r, next_obs, terminal,
+            truncated=bool(info.get("truncated", False)))
+        h.push_step(transitions)
+        episode_steps += 1
+        episode_reward += float(r)
+        obs = next_obs
+        if terminal:
+            h.end_episode(episode_steps, episode_reward,
+                          solved=bool(info.get("solved",
+                                               episode_reward > 0)))
+            obs = h.env.reset()
+            ou.reset_states()  # fresh noise path per episode
+            episode_steps, episode_reward = 0, 0.0
+        h.maybe_sync()
+    h.shutdown()

@@ -1,0 +1,122 @@
+"""Cross-process clocks and stat accumulators.
+
+Equivalent of the reference's shared log-counter structs
+(reference core/single_processes/logs.py): every field is a
+``multiprocessing.Value`` from the spawn context, so one instance created by
+the orchestrator is addressable from every worker, whether workers are OS
+processes (production) or threads (tests).  As in the reference, the
+**learner step is the global clock** that terminates every loop
+(reference logs.py:6, dqn_actor.py:62), and actor/learner stats are
+push-accumulated by workers then drained-and-reset by the logger on its
+cadence (reference dqn_logger.py:34-56).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+
+_CTX = mp.get_context("spawn")
+
+
+class GlobalClock:
+    """The global step counters (reference logs.py:3-6)."""
+
+    def __init__(self):
+        self.actor_step = _CTX.Value("l", 0, lock=True)
+        self.learner_step = _CTX.Value("l", 0, lock=True)
+        # Cooperative shutdown — the supervision layer the reference lacks
+        # (SURVEY.md §5 "failure detection: none"): a dead learner there
+        # stalls the clock and every loop spins forever; here the runtime
+        # sets this flag when any worker dies or the run completes.
+        self.stop = _CTX.Event()
+
+    def add_actor_steps(self, n: int = 1) -> int:
+        with self.actor_step.get_lock():
+            self.actor_step.value += n
+            return self.actor_step.value
+
+    def set_learner_step(self, value: int) -> None:
+        with self.learner_step.get_lock():
+            self.learner_step.value = value
+
+    def done(self, steps: int) -> bool:
+        """Termination predicate shared by every worker loop
+        (reference dqn_actor.py:62 ``learner_step >= steps``)."""
+        return self.stop.is_set() or self.learner_step.value >= steps
+
+
+class _Accumulator:
+    """A drain-and-reset float accumulator group."""
+
+    FIELDS: tuple = ()
+
+    def __init__(self):
+        self._lock = _CTX.Lock()
+        for f in self.FIELDS:
+            setattr(self, f, _CTX.Value("d", 0.0, lock=False))
+
+    def add(self, **kv: float) -> None:
+        with self._lock:
+            for k, v in kv.items():
+                getattr(self, k).value += v
+
+    def drain(self) -> dict:
+        """Read out and zero all fields atomically
+        (reference dqn_logger.py:34-55 reads then ``.value = 0``)."""
+        with self._lock:
+            out = {f: getattr(self, f).value for f in self.FIELDS}
+            for f in self.FIELDS:
+                getattr(self, f).value = 0.0
+            return out
+
+
+class ActorStats(_Accumulator):
+    """Rollout stats accumulated by all actors (reference logs.py:8-13);
+    scalar names match the reference's TensorBoard keys
+    (reference dqn_logger.py:34-47)."""
+
+    FIELDS = ("nepisodes", "nepisodes_solved", "total_steps",
+              "total_reward", "total_nframes")
+
+
+class LearnerStats(_Accumulator):
+    """Loss accumulators (reference logs.py:15-24; DDPG adds actor_loss,
+    reference ddpg_logger.py:51)."""
+
+    FIELDS = ("counter", "critic_loss", "actor_loss", "q_mean", "grad_norm",
+              "steps_per_sec")
+
+
+class EvaluatorStats:
+    """Evaluator -> logger handshake (reference logs.py:26-33): evaluator
+    writes a snapshot and raises the flag; the logger consumes and lowers it
+    (reference evaluators.py:90-95, dqn_logger.py:23-33)."""
+
+    FIELDS = ("avg_steps", "avg_reward", "nepisodes", "nepisodes_solved")
+
+    def __init__(self):
+        self._lock = _CTX.Lock()
+        self.flag = _CTX.Value("b", 0, lock=False)
+        self.at_step = _CTX.Value("l", 0, lock=False)
+        # raised when the evaluator exits (after its final eval+checkpoint)
+        # so the logger drains everything before closing the run
+        self.done = _CTX.Value("b", 0, lock=False)
+        for f in self.FIELDS:
+            setattr(self, f, _CTX.Value("d", 0.0, lock=False))
+
+    def publish(self, learner_step: int, **kv: float) -> None:
+        with self._lock:
+            for k, v in kv.items():
+                getattr(self, k).value = v
+            self.at_step.value = learner_step
+            self.flag.value = 1
+
+    def consume(self):
+        """Returns (learner_step, stats dict) or None if nothing new."""
+        with self._lock:
+            if not self.flag.value:
+                return None
+            out = {f: getattr(self, f).value for f in self.FIELDS}
+            step = self.at_step.value
+            self.flag.value = 0
+            return step, out

@@ -1,0 +1,113 @@
+"""Evaluator process: periodic greedy evaluation + checkpointing.
+
+Re-design of reference core/single_processes/evaluators.py (shared by both
+agent families, reference utils/factory.py:28-29): wake on a short poll,
+every ``evaluator_freq`` seconds pull the freshest published weights, run
+``evaluator_nepisodes`` greedy episodes in ``env.eval()`` mode, hand the
+stats to the logger through the EvaluatorStats flag handshake (reference
+:90-95), and write the params-only checkpoint — the reference's only
+checkpoint writer (reference :97-100).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.config import Options
+from pytorch_distributed_tpu.factory import (
+    EnvSpec, build_env, build_model, init_params,
+)
+from pytorch_distributed_tpu.agents.clocks import EvaluatorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import (
+    ParamStore, make_flattener,
+)
+from pytorch_distributed_tpu.utils import checkpoint as ckpt
+from pytorch_distributed_tpu.utils.rngs import process_seed
+
+
+def greedy_episodes(opt: Options, spec: EnvSpec, model, params, env,
+                    nepisodes: int) -> Tuple[float, float, int]:
+    """Run n greedy episodes; returns (avg_steps, avg_reward, solved).
+    Greedy = eps 0 for DQN (reference evaluators.py:56-86), noiseless policy
+    forward for DDPG."""
+    if opt.agent_type == "dqn":
+        from pytorch_distributed_tpu.models.policies import build_greedy_act
+
+        act = build_greedy_act(model.apply)
+
+        def pick(obs):
+            a, _ = act(params, obs[None])
+            return int(a[0])
+    else:
+        from pytorch_distributed_tpu.models.policies import build_ddpg_act
+
+        dact = build_ddpg_act(
+            lambda p, o: model.apply(p, o, method=model.forward_actor))
+
+        def pick(obs):
+            return np.asarray(dact(params, obs[None]))[0]
+
+    total_steps, total_reward, solved = 0, 0.0, 0
+    for _ in range(nepisodes):
+        obs = env.reset()
+        ep_reward, ep_steps, terminal, info = 0.0, 0, False, {}
+        while not terminal:
+            obs, r, terminal, info = env.step(pick(obs))
+            ep_reward += float(r)
+            ep_steps += 1
+        total_steps += ep_steps
+        total_reward += ep_reward
+        solved += int(bool(info.get("solved", ep_reward > 0)))
+    return total_steps / nepisodes, total_reward / nepisodes, solved
+
+
+def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
+                  param_store: ParamStore, clock: GlobalClock,
+                  stats: EvaluatorStats) -> None:
+    ap = opt.agent_params
+    env = build_env(opt, process_ind=opt.num_actors + 1)
+    env.eval()  # standard episode boundaries (reference evaluators.py:19)
+    model = build_model(opt, spec)
+    params0 = init_params(opt, spec, model, seed=process_seed(
+        opt.seed, "evaluator"))
+    _, unravel = make_flattener(params0)
+
+    version = 0
+    params = None
+
+    def evaluate() -> None:
+        nonlocal version, params
+        got = param_store.fetch(version)
+        if got is not None:
+            flat, version = got
+            params = unravel(flat)
+        if params is None:
+            return  # learner hasn't published yet
+        avg_steps, avg_reward, solved = greedy_episodes(
+            opt, spec, model, params, env, ap.evaluator_nepisodes)
+        stats.publish(
+            clock.learner_step.value,
+            avg_steps=avg_steps,
+            avg_reward=avg_reward,
+            nepisodes=float(ap.evaluator_nepisodes),
+            nepisodes_solved=float(solved),
+        )
+        # the params-only checkpoint (reference evaluators.py:97-100)
+        ckpt.save_params(ckpt.params_path(opt.model_name), params)
+
+    try:
+        last_eval = 0.0  # evaluate immediately once weights exist
+        while not clock.done(ap.steps):
+            time.sleep(0.25)  # reference evaluators.py wakes every 5 s
+            if time.monotonic() - last_eval < ap.evaluator_freq:
+                continue
+            last_eval = time.monotonic()
+            evaluate()
+        # final eval of the finished weights (short runs may never have hit
+        # the cadence; the run's acceptance signal must still be written)
+        evaluate()
+    finally:
+        stats.done.value = 1

@@ -1,0 +1,144 @@
+"""Learner process: the compute-critical update loop.
+
+Re-design of reference core/single_processes/dqn_learner.py:50-95 /
+ddpg_learner.py:50-106.  Same cadence contract — gate on
+``memory.size > learn_start`` with a sleep spin (reference dqn_learner.py:
+51,102-103), one sampled minibatch per step, target-net update folded into
+the step, global learner clock increment (reference :94-95), loss stats on
+the ``learner_freq`` cadence (reference :99-101) — but the update itself is
+one pure jitted XLA program (ops/losses.py) dispatched through
+``ShardedLearner``: batch dp-sharded over the mesh, gradients all-reduced
+over ICI, params/opt-state donated so the TrainState updates in place in
+HBM.  Where the reference's Adam writes become instantly visible through
+shared CUDA storage (reference :87), here the learner explicitly publishes
+versioned parameter snapshots every ``param_publish_freq`` steps.
+
+A single learner process drives the whole mesh; the reference's
+``num_learners > 1`` hogwild hook (unsynchronized racing Adam steps,
+SURVEY.md "known quirks") maps to widening the mesh's dp axis instead.
+
+PER additions (the reference's TODO): queue-fed single-owner buffer
+(memory/feeder.py) drained each step, |TD| priority write-back after every
+update.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from pytorch_distributed_tpu.config import Options
+from pytorch_distributed_tpu.factory import (
+    EnvSpec, build_model, build_train_state_and_step, init_params,
+    published_params,
+)
+from pytorch_distributed_tpu.agents.clocks import GlobalClock, LearnerStats
+from pytorch_distributed_tpu.agents.param_store import (
+    ParamStore, make_flattener,
+)
+from pytorch_distributed_tpu.memory.feeder import QueueOwner
+from pytorch_distributed_tpu.utils import checkpoint as ckpt
+from pytorch_distributed_tpu.utils.rngs import np_rng
+
+
+def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
+                param_store: ParamStore, clock: GlobalClock,
+                stats: LearnerStats) -> None:
+    import jax
+    from jax.flatten_util import ravel_pytree
+
+    from pytorch_distributed_tpu.parallel.learner import ShardedLearner
+    from pytorch_distributed_tpu.parallel.mesh import make_mesh
+
+    ap = opt.agent_params
+    pp = opt.parallel_params
+
+    # ---- model + train state (reference dqn_learner.py:21-39) ----
+    model = build_model(opt, spec)
+    params = init_params(opt, spec, model, seed=opt.seed)
+    if opt.model_file:
+        # finetune-from-file (reference main.py:45)
+        path = ckpt.params_path(opt.model_file) \
+            if not opt.model_file.endswith(".msgpack") else opt.model_file
+        params = ckpt.load_params(path, params)
+    state, step_fn = build_train_state_and_step(opt, spec, model, params)
+
+    mesh = None
+    if len(jax.devices()) > 1:
+        mesh = make_mesh(pp.dp_size, pp.mp_size)
+    learner = ShardedLearner(step_fn, mesh, donate=pp.donate)
+    state = learner.place(state)
+
+    # resume full state if a prior run left one (the resume tier the
+    # reference lacks, utils/checkpoint.py docstring)
+    restored = ckpt.restore_train_state(opt.model_name, jax.device_get(state))
+    if restored is not None:
+        state = learner.place(restored)
+
+    # ---- initial publication: actors block on version 1 ----
+    def _publish(st) -> None:
+        flat, _ = ravel_pytree(jax.device_get(published_params(opt, st)))
+        param_store.publish(np.asarray(flat, dtype=np.float32))
+
+    _publish(state)
+
+    is_per = isinstance(memory, QueueOwner)
+    rng = np_rng(opt.seed, "learner", process_ind)
+    lstep = int(jax.device_get(state.step))
+    clock.set_learner_step(lstep)
+
+    # ---- gate until the replay warms up (reference dqn_learner.py:51) ----
+    # clamped to capacity: a learn_start >= memory_size would otherwise spin
+    # forever since a full ring's size never exceeds its capacity
+    learn_start = min(ap.learn_start, opt.memory_params.memory_size - 1)
+    while not clock.done(ap.steps) and memory_size(memory) <= learn_start:
+        time.sleep(0.05)
+
+    # metric refs are collected per step without forcing a device sync and
+    # converted to floats only on the learner_freq cadence
+    pending_metrics = []
+    t_cadence = time.monotonic()
+
+    while lstep < ap.steps and not clock.stop.is_set():
+        if is_per:
+            memory.drain()
+        batch = memory.sample(ap.batch_size, rng)
+        state, metrics, td_abs = learner.step(state, batch)
+        if is_per:
+            memory.update_priorities(np.asarray(batch.index),
+                                     np.asarray(td_abs))
+        lstep += 1
+        clock.set_learner_step(lstep)  # reference dqn_learner.py:94-95
+        pending_metrics.append(metrics)
+
+        if lstep % ap.param_publish_freq == 0:
+            _publish(state)
+        if ap.checkpoint_freq and lstep % ap.checkpoint_freq == 0:
+            ckpt.save_train_state(opt.model_name, state)
+
+        if lstep % ap.learner_freq == 0:  # reference dqn_learner.py:99-101
+            now = time.monotonic()
+            vals = {k: float(np.mean([float(m[k]) for m in pending_metrics]))
+                    for k in pending_metrics[-1]}
+            pending_metrics = []
+            stats.add(
+                counter=1,
+                critic_loss=vals.get("learner/critic_loss", 0.0),
+                actor_loss=vals.get("learner/actor_loss", 0.0),
+                q_mean=vals.get("learner/q_mean", 0.0),
+                grad_norm=vals.get("learner/grad_norm", 0.0),
+                steps_per_sec=ap.learner_freq / max(now - t_cadence, 1e-9),
+            )
+            t_cadence = now
+
+    # final publication + full-state checkpoint so a next run can resume
+    _publish(state)
+    ckpt.save_train_state(opt.model_name, state)
+
+
+def memory_size(memory: Any) -> int:
+    if isinstance(memory, QueueOwner):
+        memory.drain()
+    return memory.size

@@ -1,0 +1,80 @@
+"""Logger process: metrics aggregation.
+
+Re-design of reference core/single_processes/dqn_logger.py /
+ddpg_logger.py (near-identical files; unified here).  Same push model: the
+workers accumulate into shared counter structs and this process drains on a
+cadence — evaluator scalars whenever the flag handshake is raised (reference
+dqn_logger.py:23-33), actor/learner accumulators every ``logger_freq``
+seconds (reference :34-55) — writing every scalar against the global
+learner step as x-axis, with the reference's exact tag names
+(utils/metrics.py docstring).
+"""
+
+from __future__ import annotations
+
+import time
+
+from pytorch_distributed_tpu.config import Options
+from pytorch_distributed_tpu.agents.clocks import (
+    ActorStats, EvaluatorStats, GlobalClock, LearnerStats,
+)
+from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+
+
+def run_logger(opt: Options, clock: GlobalClock, actor_stats: ActorStats,
+               learner_stats: LearnerStats,
+               evaluator_stats: EvaluatorStats) -> None:
+    ap = opt.agent_params
+    writer = MetricsWriter(opt.log_dir, enable_tensorboard=opt.visualize)
+    last_drain = time.monotonic()
+    finished_at = None
+    try:
+        while True:
+            finished = clock.done(ap.steps)
+            if finished and finished_at is None:
+                finished_at = time.monotonic()
+            # after the run ends, keep draining until the evaluator's final
+            # eval lands (grace-capped) so its scalars are not dropped
+            closing = finished and (
+                evaluator_stats.done.value
+                or time.monotonic() - finished_at > 60.0)
+            time.sleep(0.2 if not closing else 0.0)
+
+            got = evaluator_stats.consume()
+            if got is not None:
+                at_step, ev = got  # reference dqn_logger.py:23-33
+                writer.scalars({
+                    "evaluator/avg_steps": ev["avg_steps"],
+                    "evaluator/avg_reward": ev["avg_reward"],
+                    "evaluator/nepisodes": ev["nepisodes"],
+                    "evaluator/nepisodes_solved": ev["nepisodes_solved"],
+                }, step=at_step)
+
+            if closing or time.monotonic() - last_drain >= ap.logger_freq:
+                last_drain = time.monotonic()
+                step = clock.learner_step.value
+                a = actor_stats.drain()  # reference dqn_logger.py:34-47
+                if a["nepisodes"] > 0:
+                    writer.scalars({
+                        "actor/avg_steps": a["total_steps"] / a["nepisodes"],
+                        "actor/avg_reward": a["total_reward"] / a["nepisodes"],
+                        "actor/nepisodes_solved": a["nepisodes_solved"],
+                    }, step=step)
+                if a["total_nframes"] > 0:
+                    writer.scalar("actor/total_nframes", a["total_nframes"],
+                                  step=step)
+                le = learner_stats.drain()  # reference dqn_logger.py:48-55
+                if le["counter"] > 0:
+                    writer.scalars({
+                        "learner/critic_loss": le["critic_loss"] / le["counter"],
+                        "learner/actor_loss": le["actor_loss"] / le["counter"],
+                        "learner/q_mean": le["q_mean"] / le["counter"],
+                        "learner/grad_norm": le["grad_norm"] / le["counter"],
+                        "learner/steps_per_sec":
+                            le["steps_per_sec"] / le["counter"],
+                    }, step=step)
+                writer.flush()
+            if closing:
+                break
+    finally:
+        writer.close()

@@ -1,0 +1,111 @@
+"""Versioned parameter publication.
+
+The explicit replacement for the reference's implicit weight plane: there,
+the learner's in-place Adam writes to a shared-CUDA-storage model are
+instantly visible to actors' unsynchronized ``load_state_dict`` reads
+(reference main.py:44-47, dqn_learner.py:87, dqn_actor.py:176-178; SURVEY.md
+§2 mechanism 2).  TPU-first there is no shared device storage across
+processes, so publication is explicit and versioned:
+
+- the learner flattens its param pytree (``ravel_pytree``) and writes the
+  flat fp32 vector into a shared-memory page under a lock, bumping a version
+  counter — one coherent snapshot per publish, never a torn read (the
+  reference tolerates torn reads by design; we get coherence for free);
+- actors/evaluators poll ``fetch(min_version=...)`` on their sync cadence
+  (reference ``actor_sync_freq``) and unravel into their local pytree; a
+  fetch that finds no newer version costs one integer read.
+
+Staleness bound: learner publish cadence + actor sync cadence, matching the
+reference's <=100-actor-step bound (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import multiprocessing as mp
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+_CTX = mp.get_context("spawn")
+
+PyTree = Any
+
+
+class ParamStore:
+    """One published flat-fp32 parameter snapshot + version counter."""
+
+    def __init__(self, num_params: int):
+        self.num_params = num_params
+        self._buf = _CTX.Array(ctypes.c_float, num_params, lock=False)
+        self._version = _CTX.Value("l", 0, lock=False)
+        self._lock = _CTX.Lock()
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_np", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+
+    @property
+    def _view(self) -> np.ndarray:
+        np_view = getattr(self, "_np", None)
+        if np_view is None:
+            np_view = np.frombuffer(self._buf, dtype=np.float32)
+            self._np = np_view
+        return np_view
+
+    @property
+    def version(self) -> int:
+        return self._version.value
+
+    def publish(self, flat: np.ndarray) -> int:
+        """Write one coherent snapshot; returns the new version."""
+        flat = np.asarray(flat, dtype=np.float32).ravel()
+        assert flat.size == self.num_params, (flat.size, self.num_params)
+        with self._lock:
+            self._view[:] = flat
+            self._version.value += 1
+            return self._version.value
+
+    def fetch(self, min_version: int = 0
+              ) -> Optional[Tuple[np.ndarray, int]]:
+        """Copy out (flat, version) if a snapshot newer than ``min_version``
+        exists, else None (cheap no-op — the common case on the actor sync
+        cadence)."""
+        if self._version.value <= min_version:
+            return None
+        with self._lock:
+            return self._view.copy(), self._version.value
+
+    def wait(self, min_version: int = 0, timeout: float = 60.0,
+             poll: float = 0.05, stop=None) -> Tuple[np.ndarray, int]:
+        """Block until a snapshot newer than ``min_version`` appears —
+        workers use this at startup so nobody acts on unseeded weights
+        (the reference instead hard-syncs from the pre-spawn global model,
+        reference dqn_actor.py:26-30)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while True:
+            got = self.fetch(min_version)
+            if got is not None:
+                return got
+            if stop is not None and stop.is_set():
+                raise RuntimeError("stopped while waiting for params")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no params published within {timeout}s")
+            time.sleep(poll)
+
+
+def make_flattener(params: PyTree) -> Tuple[np.ndarray, Callable]:
+    """Build (flat0, unravel) for a param pytree via ravel_pytree; every
+    worker constructs the same tree structure from the same model config, so
+    unravel on one side inverts ravel on the other."""
+    from jax.flatten_util import ravel_pytree
+
+    flat, unravel = ravel_pytree(params)
+    return np.asarray(flat, dtype=np.float32), unravel

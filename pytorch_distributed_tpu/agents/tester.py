@@ -1,0 +1,45 @@
+"""Tester: mode-2 offline evaluation of a saved checkpoint.
+
+Re-design of reference core/single_processes/testers.py: load the params
+checkpoint named by ``model_file`` (the reference loads a .pth state_dict on
+CPU, reference :18-25), run ``tester_nepisodes`` greedy episodes, report
+``avg_steps / avg_reward / nepisodes_solved`` (reference :78-83).  Returns
+the stats dict so callers (main, tests) can assert on it instead of parsing
+stdout.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from pytorch_distributed_tpu.config import Options
+from pytorch_distributed_tpu.factory import (
+    EnvSpec, build_env, build_model, init_params,
+)
+from pytorch_distributed_tpu.agents.evaluator import greedy_episodes
+from pytorch_distributed_tpu.utils import checkpoint as ckpt
+from pytorch_distributed_tpu.utils.rngs import process_seed
+
+
+def run_tester(opt: Options, spec: EnvSpec) -> Dict[str, float]:
+    ap = opt.agent_params
+    env = build_env(opt, process_ind=0)
+    env.eval()
+    model = build_model(opt, spec)
+    template = init_params(opt, spec, model,
+                           seed=process_seed(opt.seed, "tester"))
+    path = opt.model_file
+    assert path, "mode 2 needs model_file (reference utils/options.py:45-48)"
+    if not path.endswith(".msgpack"):
+        path = ckpt.params_path(path)
+    params = ckpt.load_params(path, template)
+    avg_steps, avg_reward, solved = greedy_episodes(
+        opt, spec, model, params, env, ap.tester_nepisodes)
+    out = {
+        "avg_steps": avg_steps,
+        "avg_reward": avg_reward,
+        "nepisodes": float(ap.tester_nepisodes),
+        "nepisodes_solved": float(solved),
+    }
+    print(f"[tester] {out}")  # reference testers.py:78-83 prints to stdout
+    return out

@@ -143,6 +143,11 @@ class AgentParams:
     evaluator_freq: int = 30           # secs; ddpg: 60
     evaluator_nepisodes: int = 2
     tester_nepisodes: int = 50
+    # --- TPU-native publication/checkpoint cadence (no reference
+    # equivalent: there weight visibility is implicit shared-CUDA and only
+    # the evaluator checkpoints) ---
+    param_publish_freq: int = 10       # learner steps between ParamStore publishes
+    checkpoint_freq: int = 0           # learner steps between full-state Orbax saves (0 = final only)
     # --- off-policy core (reference :134-137 / :163-166) ---
     learn_start: int = 5000            # ddpg: 250
     batch_size: int = 128              # ddpg: 64

@@ -1,0 +1,284 @@
+"""Registry / factory — the plugin surface.
+
+Equivalent of reference ``utils/factory.py``: type-string keyed dicts for
+every pluggable component family (envs :34, memories :37, models :42,
+actor/learner/evaluator/tester/logger process functions :22-31), plus the
+builder helpers that ``main``/runtime use to turn an ``Options`` into live
+objects (the dummy-env shape probe of reference main.py:23-31 lives here as
+``probe_env``).  Divergences on purpose: ``dqn-mlp`` is registered (the
+reference leaves it out, reference utils/factory.py:42-43), and the builders
+return *functional* pieces — Flax modules, apply fns, optax transforms, pure
+train-step closures — not stateful torch modules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.config import Options
+from pytorch_distributed_tpu.envs import (
+    FakeChainEnv, PongSimEnv, make_classic_env,
+)
+from pytorch_distributed_tpu.envs.atari import AtariEnv
+from pytorch_distributed_tpu.memory import (
+    PrioritizedReplay, SharedReplay,
+)
+from pytorch_distributed_tpu.memory.feeder import QueueFeeder, QueueOwner
+
+# ---------------------------------------------------------------------------
+# Component dicts (reference utils/factory.py:22-43)
+# ---------------------------------------------------------------------------
+
+EnvsDict: Dict[str, Callable] = {
+    "atari": AtariEnv,            # reference factory.py:34 "atari"
+    "fake": FakeChainEnv,         # test/smoke env (no reference equivalent)
+    "classic": make_classic_env,  # cartpole / pendulum
+    "pong-sim": PongSimEnv,       # ALE-free Pong clone
+}
+
+MemoriesDict: Dict[str, Optional[Callable]] = {
+    "shared": SharedReplay,           # reference factory.py:37 "shared"
+    "prioritized": PrioritizedReplay,  # finishes the reference's PER TODO
+    "none": None,                      # reference factory.py:38
+}
+
+# model ctors bound in build_model below (they need probed shapes)
+ModelTypes = ("dqn-cnn", "dqn-mlp", "ddpg-mlp")
+
+
+def _worker_dicts():
+    # Imported lazily: agents modules import jax-heavy pieces and, under
+    # spawn, child processes must be able to import this module before
+    # choosing their jax platform.
+    from pytorch_distributed_tpu.agents import actor as _actor
+    from pytorch_distributed_tpu.agents import evaluator as _evaluator
+    from pytorch_distributed_tpu.agents import learner as _learner
+    from pytorch_distributed_tpu.agents import logger as _logger
+    from pytorch_distributed_tpu.agents import tester as _tester
+
+    return {
+        # reference utils/factory.py:22-31
+        "actors": {"dqn": _actor.run_dqn_actor,
+                   "ddpg": _actor.run_ddpg_actor},
+        "learners": {"dqn": _learner.run_learner,
+                     "ddpg": _learner.run_learner},
+        "evaluators": {"dqn": _evaluator.run_evaluator,
+                       "ddpg": _evaluator.run_evaluator},
+        "testers": {"dqn": _tester.run_tester,
+                    "ddpg": _tester.run_tester},
+        "loggers": {"dqn": _logger.run_logger,
+                    "ddpg": _logger.run_logger},
+    }
+
+
+def get_worker(role: str, agent_type: str) -> Callable:
+    return _worker_dicts()[role + "s"][agent_type]
+
+
+# ---------------------------------------------------------------------------
+# Env probe + builders
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class EnvSpec:
+    """What the models/replay need to know about an env — the product of the
+    dummy-env probe (reference main.py:23-31 mutates Options with
+    state_shape/action_dim/norm_val; here it is an explicit value)."""
+
+    state_shape: Tuple[int, ...]
+    discrete: bool
+    num_actions: int        # discrete action count (0 if continuous)
+    action_dim: int         # continuous action dim (0 if discrete)
+    norm_val: float
+
+    @property
+    def action_shape(self) -> Tuple[int, ...]:
+        return () if self.discrete else (self.action_dim,)
+
+    @property
+    def action_dtype(self):
+        return np.int32 if self.discrete else np.float32
+
+
+def build_env(opt: Options, process_ind: int = 0):
+    ctor = EnvsDict[opt.env_type]
+    return ctor(opt.env_params, process_ind)
+
+
+def probe_env(opt: Options) -> EnvSpec:
+    """Instantiate a throwaway env to read shapes (reference main.py:23-31)."""
+    env = build_env(opt, process_ind=0)
+    space = env.action_space
+    discrete = hasattr(space, "n")
+    return EnvSpec(
+        state_shape=tuple(env.state_shape),
+        discrete=discrete,
+        num_actions=space.n if discrete else 0,
+        action_dim=0 if discrete else space.dim,
+        norm_val=float(env.norm_val),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Model builders
+# ---------------------------------------------------------------------------
+
+def build_model(opt: Options, spec: EnvSpec):
+    """Flax module for the configured model_type (reference factory.py:42-43
+    + model ctor calls in main.py:44)."""
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models import (
+        DdpgMlpModel, DqnCnnModel, DqnMlpModel,
+    )
+
+    mp_ = opt.model_params
+    if opt.model_type == "dqn-cnn":
+        return DqnCnnModel(
+            action_space=spec.num_actions,
+            norm_val=spec.norm_val,
+            orthogonal_init=mp_.orthogonal_init,
+            compute_dtype=jnp.dtype(mp_.compute_dtype),
+        )
+    if opt.model_type == "dqn-mlp":
+        return DqnMlpModel(
+            action_space=spec.num_actions,
+            hidden_dim=mp_.hidden_dim,
+            norm_val=spec.norm_val,
+        )
+    if opt.model_type == "ddpg-mlp":
+        assert not spec.discrete, "ddpg-mlp needs a continuous action space"
+        return DdpgMlpModel(action_dim=spec.action_dim,
+                            norm_val=spec.norm_val)
+    raise ValueError(f"unknown model_type: {opt.model_type}")
+
+
+def example_obs(opt: Options, spec: EnvSpec, batch: int = 1):
+    import jax.numpy as jnp
+
+    dtype = jnp.uint8 if opt.memory_params.state_dtype == "uint8" \
+        else jnp.float32
+    return jnp.zeros((batch, *spec.state_shape), dtype=dtype)
+
+
+def init_params(opt: Options, spec: EnvSpec, model, seed: int):
+    import jax
+
+    return model.init(jax.random.PRNGKey(seed), example_obs(opt, spec))
+
+
+def ddpg_applies(model) -> Tuple[Callable, Callable]:
+    actor_apply = lambda p, o: model.apply(p, o, method=model.forward_actor)
+    critic_apply = lambda p, o, a: model.apply(p, o, a,
+                                               method=model.forward_critic)
+    return actor_apply, critic_apply
+
+
+# ---------------------------------------------------------------------------
+# Train-step builder (the learner's pure XLA program)
+# ---------------------------------------------------------------------------
+
+def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params):
+    """Returns (TrainState, step_fn) for the configured agent family, wiring
+    optimizers/targets exactly as ops/losses.py documents."""
+    from pytorch_distributed_tpu.ops.losses import (
+        build_ddpg_train_step, build_ddpg_train_step_coupled,
+        build_dqn_train_step, init_ddpg_train_state, init_train_state,
+        make_optimizer,
+    )
+
+    ap = opt.agent_params
+    if opt.agent_type == "dqn":
+        tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay)
+        state = init_train_state(params, tx)
+        step = build_dqn_train_step(
+            model.apply, tx,
+            enable_double=ap.enable_double,
+            target_model_update=ap.target_model_update,
+        )
+        return state, step
+
+    if opt.agent_type == "ddpg":
+        actor_apply, critic_apply = ddpg_applies(model)
+        if ap.ddpg_coupled_update:
+            tx = make_optimizer(ap.lr, ap.clip_grad)
+            state = init_train_state(params, tx)
+            step = build_ddpg_train_step_coupled(
+                actor_apply, critic_apply, tx,
+                target_model_update=ap.target_model_update,
+            )
+        else:
+            atx = make_optimizer(ap.lr, ap.clip_grad)
+            ctx_ = make_optimizer(ap.critic_lr, ap.clip_grad)
+            state = init_ddpg_train_state(params, atx, ctx_)
+            step = build_ddpg_train_step(
+                actor_apply, critic_apply, atx, ctx_,
+                target_model_update=ap.target_model_update,
+            )
+        return state, step
+
+    raise ValueError(f"unknown agent_type: {opt.agent_type}")
+
+
+def published_params(opt: Options, state) -> Any:
+    """The param tree the learner publishes to actors: the full model tree
+    (merged back for decoupled DDPG, whose TrainState splits it)."""
+    if opt.agent_type == "ddpg" and not opt.agent_params.ddpg_coupled_update:
+        from pytorch_distributed_tpu.ops.losses import merge_ddpg_params
+
+        return merge_ddpg_params(state.params["actor"],
+                                 state.params["critic"])
+    return state.params
+
+
+# ---------------------------------------------------------------------------
+# Memory routing
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MemoryHandles:
+    """How the topology plugs a memory_type in:
+
+    - ``actor_side``: what actor processes call ``feed`` on;
+    - ``learner_side``: what the learner samples from (and updates
+      priorities on);
+    - for the shared ring both are the same object (reference
+      shared_memory.py's one global buffer); for PER the actor side is a
+      queue feeder and the learner side the single-owner tree buffer
+      (memory/prioritized.py docstring).
+    """
+
+    actor_side: Any
+    learner_side: Any
+
+
+def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
+    mp_ = opt.memory_params
+    state_dtype = np.uint8 if mp_.state_dtype == "uint8" else np.float32
+    if opt.memory_type == "shared":
+        mem = SharedReplay(
+            capacity=mp_.memory_size,
+            state_shape=spec.state_shape,
+            action_shape=spec.action_shape,
+            state_dtype=state_dtype,
+            action_dtype=spec.action_dtype,
+        )
+        return MemoryHandles(actor_side=mem, learner_side=mem)
+    if opt.memory_type == "prioritized":
+        per = PrioritizedReplay(
+            capacity=mp_.memory_size,
+            state_shape=spec.state_shape,
+            action_shape=spec.action_shape,
+            state_dtype=state_dtype,
+            action_dtype=spec.action_dtype,
+            priority_exponent=mp_.priority_exponent,
+            importance_weight=mp_.priority_weight,
+            importance_anneal_steps=opt.agent_params.steps,
+        )
+        owner = QueueOwner(per)
+        return MemoryHandles(actor_side=owner.make_feeder(),
+                             learner_side=owner)
+    raise ValueError(f"unknown memory_type: {opt.memory_type}")

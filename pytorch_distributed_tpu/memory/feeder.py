@@ -1,0 +1,89 @@
+"""Queue-based transition routing for single-owner memories.
+
+The shared ring buffer lets every process write the same pages (reference
+core/memories/shared_memory.py); the prioritized buffer's sum/min trees
+cannot be shared pages without a cross-process lock on every tree node, so
+PER is **single-owner**: the learner process owns the buffer and actors
+stream transitions to it over a spawn-context queue — the Ape-X topology
+proper (actors push batches of experience to the replay holder).
+
+``QueueFeeder`` is the actor-side handle (chunked, so one queue message
+amortises pickling over ``chunk`` transitions); ``QueueOwner`` wraps the
+real memory on the learner side and drains pending chunks before sampling.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as _queue
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.utils.experience import Transition
+
+_CTX = mp.get_context("spawn")
+
+
+class QueueFeeder:
+    """Actor-side feed endpoint; matches the memory ``feed`` surface."""
+
+    def __init__(self, q, chunk: int = 16):
+        self._q = q
+        self._chunk = chunk
+        self._buf: List[Tuple[Transition, Optional[float]]] = []
+
+    def feed(self, transition: Transition,
+             priority: Optional[float] = None) -> None:
+        self._buf.append((transition, priority))
+        if len(self._buf) >= self._chunk:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._buf:
+            self._q.put(self._buf)
+            self._buf = []
+
+
+class QueueOwner:
+    """Learner-side owner: real memory + drain pump.
+
+    Delegates the sampling surface; ``drain()`` must run on the owner
+    process (the learner calls it before every sample)."""
+
+    def __init__(self, memory, max_queue_chunks: int = 4096):
+        self.memory = memory
+        self._q = _CTX.Queue(max_queue_chunks)
+
+    def make_feeder(self, chunk: int = 16) -> QueueFeeder:
+        return QueueFeeder(self._q, chunk)
+
+    def drain(self, max_chunks: int = 1024) -> int:
+        """Pull pending chunks into the memory; returns transitions fed."""
+        n = 0
+        for _ in range(max_chunks):
+            try:
+                items = self._q.get_nowait()
+            except _queue.Empty:
+                break
+            for transition, priority in items:
+                self.memory.feed(transition, priority)
+                n += 1
+        return n
+
+    # -- delegated sampling surface ----------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self.memory.size
+
+    def sample(self, batch_size: int, rng: np.random.Generator):
+        return self.memory.sample(batch_size, rng)
+
+    def update_priorities(self, indices: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        self.memory.update_priorities(indices, priorities)
+
+    def feed(self, transition: Transition,
+             priority: Optional[float] = None) -> None:
+        self.memory.feed(transition, priority)

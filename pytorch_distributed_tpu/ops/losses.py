@@ -140,6 +140,24 @@ def build_dqn_train_step(
     return step
 
 
+def init_ddpg_train_state(
+    full_params: PyTree,
+    actor_tx: optax.GradientTransformation,
+    critic_tx: optax.GradientTransformation,
+) -> TrainState:
+    """TrainState for the decoupled DDPG update: params/opt_state are
+    {'actor':..., 'critic':...} dicts over the split module tree; the target
+    is an independent buffer copy (same donation-safety constraint as
+    ``init_train_state``)."""
+    split = split_ddpg_params(full_params)
+    target = jax.tree_util.tree_map(jnp.array, split)
+    return TrainState(
+        split, target,
+        {"actor": actor_tx.init(split["actor"]),
+         "critic": critic_tx.init(split["critic"])},
+        jnp.asarray(0))
+
+
 def build_ddpg_train_step(
     actor_apply_fn: Callable,
     critic_apply_fn: Callable,
@@ -204,7 +222,9 @@ def build_ddpg_train_step(
         metrics = {
             "learner/critic_loss": critic_loss,
             "learner/actor_loss": actor_loss,
-            "learner/grad_norm": global_norm(critic_grads),
+            # norm over BOTH nets' grads so a diverging policy is visible
+            "learner/grad_norm": global_norm(
+                {"actor": actor_grads, "critic": critic_grads}),
         }
         return (TrainState(new_params, new_target,
                            {"actor": actor_opt, "critic": critic_opt},

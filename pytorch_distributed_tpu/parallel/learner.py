@@ -58,7 +58,7 @@ class ShardedLearner:
 
     def shard_batch(self, batch: Batch) -> Batch:
         if self._batch_sharding is None:
-            return batch
+            return jax.device_put(batch)
         dp = self.mesh.shape["dp"]
         bsz = batch.reward.shape[0]
         if bsz % dp != 0:

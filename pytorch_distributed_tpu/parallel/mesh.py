@@ -27,14 +27,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def make_mesh(dp_size: int = -1, mp_size: int = 1,
               devices=None) -> Mesh:
-    devices = list(devices if devices is not None else jax.devices())
+    explicit = devices is not None
+    devices = list(devices if explicit else jax.devices())
     n = len(devices)
     if dp_size == -1:
         assert n % mp_size == 0, f"{n} devices not divisible by mp={mp_size}"
         dp_size = n // mp_size
-    assert dp_size * mp_size <= n, (
-        f"mesh {dp_size}x{mp_size} needs more than {n} devices")
-    grid = np.array(devices[: dp_size * mp_size]).reshape(dp_size, mp_size)
+    used = dp_size * mp_size
+    assert used <= n, f"mesh {dp_size}x{mp_size} needs more than {n} devices"
+    if used < n and not explicit:
+        # an undersized explicit mesh over the default device set silently
+        # strands chips — make the throughput loss visible
+        import warnings
+
+        warnings.warn(
+            f"mesh {dp_size}x{mp_size} uses {used} of {n} available "
+            f"devices; {n - used} chip(s) idle", stacklevel=2)
+    grid = np.array(devices[:used]).reshape(dp_size, mp_size)
     return Mesh(grid, ("dp", "mp"))
 
 

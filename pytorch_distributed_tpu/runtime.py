@@ -1,0 +1,156 @@
+"""Run topology: wiring and supervision.
+
+Re-design of the reference orchestrator (reference main.py:12-118): allocate
+the shared objects (replay plane, param store, clocks — the explicit
+equivalents of the reference's shared memory at main.py:42, shared CUDA
+model at :44-47, and mp.Value logs at :51-54), then run one logger,
+``num_actors`` actors and one evaluator as workers, with **the learner in
+the parent process** — the parent owns the TPU mesh; every child pins JAX to
+CPU through the spawn trampoline, so exactly one process initialises the
+accelerator (the reference instead gives every process a CUDA context).
+Scaling learners means widening the mesh's dp axis, not adding racing
+processes (agents/learner.py docstring).
+
+Supervision — absent in the reference, where a dead worker silently stalls
+or hangs the run (SURVEY.md §5 "failure detection: none"): a monitor thread
+watches child liveness and trips the shared stop event if any child dies
+abnormally; shutdown joins with a timeout and terminates stragglers.
+
+Backends: ``process`` (spawn, production) and ``thread`` (in-process, the
+deterministic test harness SURVEY.md §4 calls for).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from pytorch_distributed_tpu.config import Options
+from pytorch_distributed_tpu.factory import (
+    EnvSpec, build_memory, get_worker, probe_env,
+)
+from pytorch_distributed_tpu.agents.clocks import (
+    ActorStats, EvaluatorStats, GlobalClock, LearnerStats,
+)
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+
+_CTX = mp.get_context("spawn")
+
+
+def _count_params(opt: Options, spec: EnvSpec) -> int:
+    from pytorch_distributed_tpu.factory import build_model, init_params
+    from pytorch_distributed_tpu.utils.helpers import tree_size
+
+    model = build_model(opt, spec)
+    return tree_size(init_params(opt, spec, model, seed=opt.seed))
+
+
+def _child_main(role: str, agent_type: str, args: tuple) -> None:
+    """Spawn trampoline: pin this child to the CPU backend *before* any JAX
+    computation, then dispatch to the worker function.  Backends initialise
+    lazily, so flipping the config here is safe even though modules were
+    imported during unpickling."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    get_worker(role, agent_type)(*args)
+
+
+class Topology:
+    """Builds the shared plane and runs the worker topology for one
+    Options."""
+
+    def __init__(self, opt: Options, spec: Optional[EnvSpec] = None):
+        self.opt = opt
+        self.spec = spec if spec is not None else probe_env(opt)
+        self.clock = GlobalClock()
+        self.actor_stats = ActorStats()
+        self.learner_stats = LearnerStats()
+        self.evaluator_stats = EvaluatorStats()
+        self.param_store = ParamStore(_count_params(opt, self.spec))
+        self.handles = build_memory(opt, self.spec)
+        self._workers: List[Any] = []
+
+    # -- worker table (reference main.py:58-106 spawn loops) ----------------
+
+    def _worker_specs(self):
+        opt, spec = self.opt, self.spec
+        specs = [("logger", 0, (opt, self.clock, self.actor_stats,
+                                self.learner_stats, self.evaluator_stats))]
+        for i in range(opt.num_actors):
+            specs.append(("actor", i, (
+                opt, spec, i, self.handles.actor_side, self.param_store,
+                self.clock, self.actor_stats)))
+        specs.append(("evaluator", 0, (
+            opt, spec, 0, None, self.param_store, self.clock,
+            self.evaluator_stats)))
+        return specs
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, backend: str = "process") -> None:
+        """Mode-1 training (reference main.py:34-106): start workers, run
+        the learner here, supervise, join."""
+        assert backend in ("process", "thread")
+        opt = self.opt
+        if backend == "process":
+            for role, ind, args in self._worker_specs():
+                p = _CTX.Process(
+                    target=_child_main, args=(role, opt.agent_type, args),
+                    name=f"{role}-{ind}", daemon=True)
+                p.start()
+                self._workers.append(p)
+            monitor = threading.Thread(target=self._monitor, daemon=True)
+            monitor.start()
+        else:
+            for role, ind, args in self._worker_specs():
+                t = threading.Thread(
+                    target=get_worker(role, opt.agent_type), args=args,
+                    name=f"{role}-{ind}", daemon=True)
+                t.start()
+                self._workers.append(t)
+
+        try:
+            run_learner = get_worker("learner", opt.agent_type)
+            run_learner(opt, self.spec, 0, self.handles.learner_side,
+                        self.param_store, self.clock, self.learner_stats)
+        finally:
+            # learner done (or dead): release every spinning loop
+            self.clock.stop.set()
+            self._join_all()
+
+    def _monitor(self, poll: float = 0.5) -> None:
+        """Trip the stop event when any child dies abnormally — the failure
+        detection the reference lacks."""
+        while not self.clock.stop.is_set():
+            for p in self._workers:
+                if isinstance(p, _CTX.Process) and p.exitcode not in (None, 0):
+                    self.clock.stop.set()
+                    return
+            time.sleep(poll)
+
+    def _join_all(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        for w in self._workers:
+            w.join(max(0.1, deadline - time.monotonic()))
+        for w in self._workers:
+            if isinstance(w, _CTX.Process) and w.is_alive():
+                w.terminate()
+                w.join(5.0)
+
+
+def train(opt: Options, backend: str = "process") -> Topology:
+    topo = Topology(opt)
+    topo.run(backend=backend)
+    return topo
+
+
+def test(opt: Options) -> Dict[str, float]:
+    """Mode-2 (reference main.py:107-115): run the tester inline."""
+    from pytorch_distributed_tpu.agents.tester import run_tester
+
+    return run_tester(opt, probe_env(opt))

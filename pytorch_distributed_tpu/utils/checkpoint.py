@@ -1,0 +1,80 @@
+"""Checkpointing.
+
+The reference checkpoints weights only: the evaluator torch.saves a
+state_dict every eval cycle (reference core/single_processes/evaluators.py:
+97-100) and restores go through finetune load (reference main.py:45) and the
+tester (reference testers.py:25) — optimizer state, counters, replay and RNG
+are all lost on resume (SURVEY.md §5 "checkpoint/resume: minimal").
+
+Here two tiers:
+
+- **params-only** (reference-parity): a Flax-serialized msgpack of the param
+  pytree at ``{model_name}.msgpack`` — written by the evaluator on its
+  cadence, read by finetune/tester.  Restore needs a template tree of the
+  same structure (``load_params(path, template)``).
+- **full train state** (the resume the reference lacks): Orbax checkpoint of
+  the whole ``TrainState`` (params + target + optimizer state + step) at
+  ``{model_name}_state/``; ``restore_train_state`` resumes the learner
+  exactly, counters included.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+PyTree = Any
+
+
+def save_params(path: str, params: PyTree) -> str:
+    """Write a params-only checkpoint (msgpack).  Returns the path."""
+    import jax
+    from flax import serialization
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    data = serialization.to_bytes(jax.device_get(params))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # atomic: readers never see a torn file
+    return path
+
+
+def load_params(path: str, template: PyTree) -> PyTree:
+    from flax import serialization
+
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())
+
+
+def params_path(model_name: str) -> str:
+    """``models/{machine}_{timestamp}.msgpack`` — the counterpart of the
+    reference's ``.pth`` path (reference utils/options.py:42)."""
+    return model_name + ".msgpack"
+
+
+def state_dir(model_name: str) -> str:
+    return os.path.abspath(model_name + "_state")
+
+
+def save_train_state(model_name: str, state: Any) -> str:
+    """Orbax save of the full TrainState (async-safe single snapshot)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = state_dir(model_name)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, jax.device_get(state), force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def restore_train_state(model_name: str, template: Any) -> Optional[Any]:
+    """Restore a TrainState saved by ``save_train_state``; None if absent."""
+    import orbax.checkpoint as ocp
+
+    path = state_dir(model_name)
+    if not os.path.isdir(path):
+        return None
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path, template)

@@ -1,0 +1,68 @@
+"""Metrics writer.
+
+The reference logs scalars through a dedicated logger process into
+TensorBoard (tensorboardX SummaryWriter, reference
+core/single_processes/dqn_logger.py:15) with the global learner step as the
+x-axis for everything.  Here the writer is a small append-only JSONL sink
+(always on — machine-readable for bench/CI) plus TensorBoard event files via
+``torch.utils.tensorboard`` when available; scalar names match the reference
+so existing dashboards carry over (``evaluator/avg_reward``,
+``actor/total_nframes``, ``learner/critic_loss``, ... — reference
+dqn_logger.py:23-55).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class MetricsWriter:
+    def __init__(self, log_dir: str, enable_tensorboard: bool = True):
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(log_dir, "scalars.jsonl"), "a",
+                           buffering=1)
+        self._tb = None
+        if enable_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=log_dir)
+            except Exception:  # noqa: BLE001 - TB is best-effort
+                self._tb = None
+
+    def scalar(self, tag: str, value: float, step: int,
+               wall: Optional[float] = None) -> None:
+        rec = {"tag": tag, "value": float(value), "step": int(step),
+               "wall": wall if wall is not None else time.time()}
+        self._jsonl.write(json.dumps(rec) + "\n")
+        if self._tb is not None:
+            self._tb.add_scalar(tag, float(value), int(step))
+
+    def scalars(self, kv: dict, step: int) -> None:
+        wall = time.time()
+        for tag, value in kv.items():
+            self.scalar(tag, value, step, wall)
+
+    def flush(self) -> None:
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+def read_scalars(log_dir: str):
+    """Load all JSONL scalar records from a run dir (tests/bench use this)."""
+    path = os.path.join(log_dir, "scalars.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

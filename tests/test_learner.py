@@ -7,8 +7,8 @@ import pytest
 from pytorch_distributed_tpu.models import DdpgMlpModel, DqnMlpModel
 from pytorch_distributed_tpu.ops.losses import (
     TrainState, build_ddpg_train_step, build_ddpg_train_step_coupled,
-    build_dqn_train_step, init_train_state, make_optimizer,
-    merge_ddpg_params, split_ddpg_params,
+    build_dqn_train_step, init_ddpg_train_state, init_train_state,
+    make_optimizer, merge_ddpg_params, split_ddpg_params,
 )
 from pytorch_distributed_tpu.parallel import ShardedLearner, make_mesh
 from pytorch_distributed_tpu.utils.experience import Batch
@@ -129,15 +129,9 @@ def _ddpg_setup(coupled=False, obs_dim=3, act_dim=1):
         state = init_train_state(full, tx)
         step = build_ddpg_train_step_coupled(actor_apply, critic_apply, tx)
     else:
-        split = split_ddpg_params(full)
         atx = make_optimizer(1e-4, clip_grad=40.0)
         ctx_ = make_optimizer(1e-3, clip_grad=40.0)
-        target = jax.tree_util.tree_map(jnp.array, split)
-        state = TrainState(
-            split, target,
-            {"actor": atx.init(split["actor"]),
-             "critic": ctx_.init(split["critic"])},
-            jnp.asarray(0))
+        state = init_ddpg_train_state(full, atx, ctx_)
         step = build_ddpg_train_step(actor_apply, critic_apply, atx, ctx_)
     return model, state, step
 
