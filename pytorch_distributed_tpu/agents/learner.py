@@ -38,6 +38,7 @@ from pytorch_distributed_tpu.agents.clocks import GlobalClock, LearnerStats
 from pytorch_distributed_tpu.agents.param_store import (
     ParamStore, make_flattener,
 )
+from pytorch_distributed_tpu.memory.device_replay import DeviceReplayIngest
 from pytorch_distributed_tpu.memory.feeder import QueueOwner
 from pytorch_distributed_tpu.utils import checkpoint as ckpt
 from pytorch_distributed_tpu.utils.rngs import np_rng
@@ -85,6 +86,30 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     _publish(state)
 
     is_per = isinstance(memory, QueueOwner)
+    is_device = isinstance(memory, DeviceReplayIngest)
+    if is_device:
+        # attach the HBM ring on the learner's mesh and fuse sampling into
+        # the train step: one XLA program does gather-from-ring + forward +
+        # backward + Adam + target update, so the hot loop never touches the
+        # host (memory/device_replay.py docstring)
+        from pytorch_distributed_tpu.memory.device_replay import sample_rows
+
+        mp_ = opt.memory_params
+        memory.attach(
+            mp_.memory_size, spec.state_shape, spec.action_shape,
+            np.uint8 if mp_.state_dtype == "uint8" else np.float32,
+            spec.action_dtype, mesh=mesh)
+        fused_step = jax.jit(
+            lambda ts, rs, key: step_fn(
+                ts, sample_rows(rs, key, ap.batch_size)),
+            donate_argnums=(0,) if pp.donate else ())
+        device_key = jax.random.PRNGKey(
+            np_rng(opt.seed, "learner", process_ind).integers(2 ** 31))
+        # the CPU backend's collective rendezvous needs per-step blocking
+        # (see ShardedLearner.step)
+        block_each_step = (mesh is not None
+                           and mesh.devices.flat[0].platform == "cpu")
+
     rng = np_rng(opt.seed, "learner", process_ind)
     lstep = int(jax.device_get(state.step))
     clock.set_learner_step(lstep)
@@ -102,13 +127,21 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     t_cadence = time.monotonic()
 
     while lstep < ap.steps and not clock.stop.is_set():
-        if is_per:
+        if is_device:
             memory.drain()
-        batch = memory.sample(ap.batch_size, rng)
-        state, metrics, td_abs = learner.step(state, batch)
-        if is_per:
-            memory.update_priorities(np.asarray(batch.index),
-                                     np.asarray(td_abs))
+            device_key, sub = jax.random.split(device_key)
+            state, metrics, td_abs = fused_step(state, memory.replay.state,
+                                                sub)
+            if block_each_step:
+                jax.block_until_ready(state.params)
+        else:
+            if is_per:
+                memory.drain()
+            batch = memory.sample(ap.batch_size, rng)
+            state, metrics, td_abs = learner.step(state, batch)
+            if is_per:
+                memory.update_priorities(np.asarray(batch.index),
+                                         np.asarray(td_abs))
         lstep += 1
         clock.set_learner_step(lstep)  # reference dqn_learner.py:94-95
         pending_metrics.append(metrics)
@@ -139,6 +172,6 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
 
 
 def memory_size(memory: Any) -> int:
-    if isinstance(memory, QueueOwner):
+    if hasattr(memory, "drain"):
         memory.drain()
     return memory.size

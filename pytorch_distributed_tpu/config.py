@@ -44,6 +44,7 @@ CONFIGS = [
     ["dqn",       "atari",     "breakout",    "shared",    "dqn-cnn"],   # 5
     ["dqn",       "pong-sim",  "pong",        "prioritized", "dqn-cnn"], # 6 PER
     ["dqn",       "atari",     "pong",        "prioritized", "dqn-cnn"], # 7 PER on ALE
+    ["dqn",       "pong-sim",  "pong",        "device",      "dqn-cnn"], # 8 HBM replay (flagship TPU)
 ]
 
 
@@ -98,10 +99,10 @@ class MemoryParams:
     # PER exponents (reference utils/options.py:92-94; Ape-X paper values).
     priority_exponent: float = 0.6
     priority_weight: float = 0.4
-    # Device-resident replay: shard the buffer across the learner mesh's
-    # data axis and sample on device (TPU-native addition; no reference
-    # equivalent — the reference buffer is host shared memory).
-    device_resident: bool = False
+    # NOTE: device-resident (HBM) replay is selected via
+    # ``memory_type="device"`` (CONFIGS row 8), not a flag here: the buffer
+    # is sharded across the learner mesh's dp axis and sampled on device
+    # fused into the train step (memory/device_replay.py).
 
 
 @dataclass

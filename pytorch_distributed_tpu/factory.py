@@ -42,6 +42,7 @@ EnvsDict: Dict[str, Callable] = {
 MemoriesDict: Dict[str, Optional[Callable]] = {
     "shared": SharedReplay,           # reference factory.py:37 "shared"
     "prioritized": PrioritizedReplay,  # finishes the reference's PER TODO
+    "device": None,                    # HBM-resident ring (device_replay.py)
     "none": None,                      # reference factory.py:38
 }
 
@@ -281,4 +282,12 @@ def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
         owner = QueueOwner(per)
         return MemoryHandles(actor_side=owner.make_feeder(),
                              learner_side=owner)
+    if opt.memory_type == "device":
+        from pytorch_distributed_tpu.memory.device_replay import (
+            DeviceReplayIngest,
+        )
+
+        ingest = DeviceReplayIngest()
+        return MemoryHandles(actor_side=ingest.make_feeder(),
+                             learner_side=ingest)
     raise ValueError(f"unknown memory_type: {opt.memory_type}")

@@ -54,7 +54,10 @@ def _feed(state: ReplayState, chunk: Transition, capacity: int) -> ReplayState:
     )
 
 
-def _sample(state: ReplayState, key: jax.Array, batch_size: int) -> Batch:
+def sample_rows(state: ReplayState, key: jax.Array,
+                batch_size: int) -> Batch:
+    """Uniform on-device sampling from the ring — public so the learner and
+    the driver dryrun can fuse it into their train-step programs."""
     idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(state.fill, 1))
     return Batch(
         state0=state.state0[idx],
@@ -91,7 +94,8 @@ class DeviceReplay:
         if mesh is not None:
             ndev = mesh.shape[axis]
             assert capacity % ndev == 0, (
-                f"capacity {capacity} must divide mesh axis {axis}={ndev}")
+                f"capacity {capacity} must be divisible by mesh axis "
+                f"{axis}={ndev} (round it via DeviceReplayIngest.attach)")
             P = jax.sharding.PartitionSpec
             self._row_sharding = jax.sharding.NamedSharding(mesh, P(axis))
             self._scalar_sharding = jax.sharding.NamedSharding(mesh, P())
@@ -103,7 +107,7 @@ class DeviceReplay:
         self._feed_fn = jax.jit(
             functools.partial(_feed, capacity=capacity), donate_argnums=0)
         self._sample_fn = jax.jit(
-            _sample, static_argnames="batch_size", donate_argnums=())
+            sample_rows, static_argnames="batch_size", donate_argnums=())
 
     def _init_state(self) -> ReplayState:
         N = self.capacity
@@ -138,3 +142,80 @@ class DeviceReplay:
 
     def sample(self, batch_size: int, key: jax.Array) -> Batch:
         return self._sample_fn(self.state, key, batch_size=batch_size)
+
+
+class DeviceReplayIngest:
+    """Cross-process front end for a device-resident ring.
+
+    Actors cannot address HBM, so (like PER) the device ring is
+    single-owner: actors stream transitions over a spawn queue via
+    ``make_feeder()`` and the learner process calls ``attach`` (after it
+    owns the mesh) then ``drain()`` per step — which assembles **fixed-size
+    chunks** host-side (fixed so ``feed_chunk`` never retraces) and ingests
+    them with one host->device transfer each; partial chunks stay pending
+    until filled.
+    """
+
+    def __init__(self, chunk_size: int = 64, max_queue_chunks: int = 4096):
+        import multiprocessing as mp
+
+        self.chunk_size = chunk_size
+        self._q = mp.get_context("spawn").Queue(max_queue_chunks)
+        self.replay: Optional[DeviceReplay] = None
+        self._pending: list = []
+        self._fed_total = 0
+
+    def make_feeder(self, chunk: int = 16):
+        from pytorch_distributed_tpu.memory.feeder import QueueFeeder
+
+        return QueueFeeder(self._q, chunk)
+
+    def attach(self, capacity: int, state_shape: Tuple[int, ...],
+               action_shape: Tuple[int, ...] = (),
+               state_dtype=np.uint8, action_dtype=np.int32,
+               mesh: Optional[jax.sharding.Mesh] = None) -> DeviceReplay:
+        if mesh is not None:
+            # round capacity up so rows split evenly across the dp axis
+            # (e.g. the default 50000 on a 32-wide mesh -> 50016)
+            ndev = mesh.shape["dp"]
+            if capacity % ndev:
+                rounded = capacity + ndev - capacity % ndev
+                import warnings
+
+                warnings.warn(
+                    f"device replay capacity {capacity} rounded up to "
+                    f"{rounded} (multiple of mesh dp={ndev})", stacklevel=2)
+                capacity = rounded
+        self.replay = DeviceReplay(
+            capacity, state_shape, action_shape, state_dtype, action_dtype,
+            mesh=mesh)
+        return self.replay
+
+    @property
+    def size(self) -> int:
+        # host-side accounting — no device sync in the hot loop
+        assert self.replay is not None, "attach() first"
+        return min(self._fed_total, self.replay.capacity)
+
+    def drain(self, max_chunks: int = 1024) -> int:
+        from pytorch_distributed_tpu.memory.feeder import pop_chunks
+        from pytorch_distributed_tpu.utils.experience import (
+            transition_dtypes,
+        )
+
+        assert self.replay is not None, "attach() first"
+        self._pending.extend(
+            t for t, _priority in pop_chunks(self._q, max_chunks))
+        fed = 0
+        C = self.chunk_size
+        dt = transition_dtypes(self.replay.state_dtype,
+                               self.replay.action_dtype)
+        while len(self._pending) >= C:
+            rows, self._pending = self._pending[:C], self._pending[C:]
+            chunk = Transition(*(
+                np.stack([getattr(r, f) for r in rows]).astype(dt[f])
+                for f in Transition._fields))
+            self.replay.feed_chunk(chunk)
+            fed += C
+        self._fed_total += fed
+        return fed

@@ -45,6 +45,19 @@ class QueueFeeder:
             self._buf = []
 
 
+def pop_chunks(q, max_chunks: int = 1024) -> List[Tuple[Transition,
+                                                        Optional[float]]]:
+    """Drain pending (transition, priority) items from a feeder queue —
+    the single queue-pop loop every single-owner memory shares."""
+    out: List[Tuple[Transition, Optional[float]]] = []
+    for _ in range(max_chunks):
+        try:
+            out.extend(q.get_nowait())
+        except _queue.Empty:
+            break
+    return out
+
+
 class QueueOwner:
     """Learner-side owner: real memory + drain pump.
 
@@ -60,16 +73,10 @@ class QueueOwner:
 
     def drain(self, max_chunks: int = 1024) -> int:
         """Pull pending chunks into the memory; returns transitions fed."""
-        n = 0
-        for _ in range(max_chunks):
-            try:
-                items = self._q.get_nowait()
-            except _queue.Empty:
-                break
-            for transition, priority in items:
-                self.memory.feed(transition, priority)
-                n += 1
-        return n
+        items = pop_chunks(self._q, max_chunks)
+        for transition, priority in items:
+            self.memory.feed(transition, priority)
+        return len(items)
 
     # -- delegated sampling surface ----------------------------------------
 

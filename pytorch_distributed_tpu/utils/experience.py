@@ -50,6 +50,14 @@ class Transition(NamedTuple):
     terminal1: np.ndarray  # () float32 in {0,1}
 
 
+def transition_dtypes(state_dtype, action_dtype) -> dict:
+    """Per-field storage dtypes of the six-array transition schema, shared
+    by every replay backend."""
+    return dict(state0=state_dtype, action=action_dtype,
+                reward=np.float32, gamma_n=np.float32,
+                state1=state_dtype, terminal1=np.float32)
+
+
 class Batch(NamedTuple):
     """A sampled minibatch (leading batch dim on every field), as handed to
     the jitted learner update."""

@@ -70,3 +70,32 @@ def test_device_replay_uint8():
     b = m.sample(4, jax.random.PRNGKey(0))
     assert b.state0.dtype == jnp.uint8
     assert int(b.state0[0, 0, 0, 0]) == 200
+
+
+def test_device_ingest_chunks_and_feeds():
+    from pytorch_distributed_tpu.memory.device_replay import DeviceReplayIngest
+
+    ing = DeviceReplayIngest(chunk_size=4)
+    ing.attach(capacity=16, state_shape=(3,), state_dtype=np.float32)
+    feeder = ing.make_feeder(chunk=2)
+    for i in range(7):
+        feeder.feed(Transition(
+            state0=np.full(3, i, np.float32), action=np.int32(i % 2),
+            reward=np.float32(i), gamma_n=np.float32(0.9),
+            state1=np.full(3, i + 1, np.float32),
+            terminal1=np.float32(0.0)))
+    feeder.flush()
+    # mp.Queue's feeder thread makes puts visible asynchronously; drain
+    # until the data lands (the learner loop drains every step anyway)
+    import time
+
+    deadline = time.monotonic() + 5.0
+    while (ing.size + len(ing._pending) < 7
+           and time.monotonic() < deadline):
+        ing.drain()
+        time.sleep(0.01)
+    # 7 fed -> one full chunk of 4 ingested, 3 pending
+    assert ing.size == 4
+    assert len(ing._pending) == 3
+    b = ing.replay.sample(8, jax.random.PRNGKey(1))
+    assert np.all(np.asarray(b.index) < 4)

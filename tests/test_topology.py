@@ -104,3 +104,14 @@ def test_resume_from_full_state(tmp_path):
     opt2 = _opts(tmp_path, config=1, steps=150, refs=opt.refs)
     topo2 = runtime.train(opt2, backend="thread")
     assert topo2.clock.learner_step.value >= 150
+
+
+def test_device_replay_topology_runs(tmp_path):
+    # flagship HBM-replay path on the fake env (config 8 is pong-sim; use
+    # the same memory_type over the cheap chain env for CI speed)
+    opt = _opts(tmp_path, config=1, memory_type="device", steps=200)
+    topo = runtime.train(opt, backend="thread")
+    assert topo.clock.learner_step.value >= 200
+    recs = read_scalars(opt.log_dir)
+    assert any(r["tag"] == "learner/critic_loss" for r in recs)
+    assert topo.handles.learner_side.size > 0
