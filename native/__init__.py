@@ -1,0 +1,11 @@
+"""First-party native (C++) components.
+
+The reference ships zero first-party native code — all its native
+capability is third-party wheels (SURVEY.md §2 "native components").  This
+package holds the TPU framework's own native runtime pieces, compiled
+on demand with the system toolchain (build.py) and bound via ctypes:
+
+- ring_buffer.cpp — lock-free shared-memory transition ring
+  (memory/native_ring.py binding)
+- env_pool.cpp — batched C++ env stepper (envs/native_pool.py binding)
+"""

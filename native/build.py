@@ -1,0 +1,52 @@
+"""Build-on-import for the native components.
+
+The image bans pip/apt installs and ships no pybind11, so native code is
+plain C++ compiled with the baked-in g++ into a shared object loaded via
+ctypes.  The .so is cached next to the source and rebuilt only when the
+source is newer (mtime check); concurrent builders race benignly through an
+atomic rename.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_library(name: str, *, flags: Optional[list] = None) -> str:
+    """Compile native/{name}.cpp -> native/build/lib{name}.so; returns the
+    .so path.  Raises NativeBuildError if the toolchain is unusable (callers
+    fall back to the pure-Python path)."""
+    src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
+    out_dir = os.path.join(_NATIVE_DIR, "build")
+    os.makedirs(out_dir, exist_ok=True)
+    so = os.path.join(out_dir, f"lib{name}.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-o", tmp, src] + (flags or [])
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:  # no g++ / hang
+        raise NativeBuildError(f"native build unavailable: {e}") from e
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"g++ failed for {name}:\n{proc.stderr[-2000:]}")
+    os.replace(tmp, so)  # atomic under concurrent builds
+    return so
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    return ctypes.CDLL(build_library(name))

@@ -1,0 +1,155 @@
+// Lock-free shared-memory transition ring buffer.
+//
+// Native replacement for the Python shared-memory replay's data plane: the
+// reference serialises every feed/sample behind ONE process-wide lock
+// (reference core/memories/shared_memory.py:37,69-75), which caps actor
+// fan-out; this ring takes the lock away entirely:
+//
+//   - writers claim slots with one atomic fetch_add on the write cursor
+//     (multi-producer, no CAS loops, no blocking);
+//   - each row carries a seqlock word: odd while a writer is copying, bumped
+//     to the next even value when done; readers copy the row and re-check
+//     the word, retrying on a torn read (single-digit-ns overhead in the
+//     common case, never blocking writers);
+//   - the region lives in POSIX shared memory created by Python
+//     (multiprocessing.shared_memory), so any process that attaches by name
+//     addresses the same pages — the same topology as the reference's
+//     .share_memory_() tensors, without its lock.
+//
+// Row = the six-field transition schema packed back-to-back
+// (state0 | action | reward | gamma_n | state1 | terminal1), exactly the
+// flat-array layout of reference shared_memory.py:19-28.
+//
+// Memory layout of the region:
+//   Header (64B aligned): magic, capacity, row_bytes, atomic u64 cursor
+//   seq[]: one atomic u32 per row (padded to 64B)
+//   data[]: capacity * row_bytes
+//
+// Build: g++ -O3 -shared -fPIC (driven by native/build.py at import).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t MAGIC = 0x52494e47425546ULL;  // "RINGBUF"
+constexpr uint64_t ALIGN = 64;
+
+struct Header {
+    uint64_t magic;
+    uint64_t capacity;
+    uint64_t row_bytes;
+    std::atomic<uint64_t> cursor;  // total rows ever written
+    char pad[ALIGN - 4 * sizeof(uint64_t)];
+};
+static_assert(sizeof(Header) == ALIGN, "header must stay one cache line");
+
+inline uint64_t align_up(uint64_t x) { return (x + ALIGN - 1) & ~(ALIGN - 1); }
+
+inline Header* header(void* base) { return reinterpret_cast<Header*>(base); }
+
+inline std::atomic<uint32_t>* seqs(void* base) {
+    return reinterpret_cast<std::atomic<uint32_t>*>(
+        static_cast<char*>(base) + sizeof(Header));
+}
+
+inline char* data(void* base, uint64_t capacity) {
+    return static_cast<char*>(base) + sizeof(Header)
+        + align_up(capacity * sizeof(uint32_t));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Total bytes the shared region needs for a given geometry.
+uint64_t rb_region_bytes(uint64_t capacity, uint64_t row_bytes) {
+    return sizeof(Header) + align_up(capacity * sizeof(uint32_t))
+        + capacity * row_bytes;
+}
+
+void rb_init(void* base, uint64_t capacity, uint64_t row_bytes) {
+    Header* h = header(base);
+    h->magic = MAGIC;
+    h->capacity = capacity;
+    h->row_bytes = row_bytes;
+    h->cursor.store(0, std::memory_order_relaxed);
+    std::atomic<uint32_t>* s = seqs(base);
+    for (uint64_t i = 0; i < capacity; ++i)
+        s[i].store(0, std::memory_order_relaxed);
+}
+
+int rb_check(void* base, uint64_t capacity, uint64_t row_bytes) {
+    Header* h = header(base);
+    return h->magic == MAGIC && h->capacity == capacity
+        && h->row_bytes == row_bytes;
+}
+
+// rows ever written (monotonic feed counter)
+uint64_t rb_total(void* base) {
+    return header(base)->cursor.load(std::memory_order_acquire);
+}
+
+// valid rows available for sampling (<= capacity)
+uint64_t rb_size(void* base) {
+    Header* h = header(base);
+    uint64_t t = h->cursor.load(std::memory_order_acquire);
+    return t < h->capacity ? t : h->capacity;
+}
+
+// Feed n contiguous rows (n * row_bytes at `rows`).  Lock-free multi-writer:
+// each call claims a contiguous index range with one fetch_add; rows wrap
+// independently.
+void rb_feed(void* base, const void* rows, uint64_t n) {
+    Header* h = header(base);
+    const uint64_t cap = h->capacity;
+    const uint64_t rb = h->row_bytes;
+    uint64_t start = h->cursor.fetch_add(n, std::memory_order_acq_rel);
+    std::atomic<uint32_t>* s = seqs(base);
+    char* d = data(base, cap);
+    const char* src = static_cast<const char*>(rows);
+    for (uint64_t k = 0; k < n; ++k) {
+        uint64_t i = (start + k) % cap;
+        // seqlock write: odd = in progress
+        uint32_t v = s[i].load(std::memory_order_relaxed);
+        s[i].store(v + 1, std::memory_order_release);
+        std::atomic_thread_fence(std::memory_order_release);
+        std::memcpy(d + i * rb, src + k * rb, rb);
+        std::atomic_thread_fence(std::memory_order_release);
+        s[i].store(v + 2, std::memory_order_release);
+    }
+}
+
+// Copy `n` rows at `indices` into `out`, each a consistent (untorn)
+// snapshot: re-read on seqlock mismatch.  Returns the number of retries
+// (diagnostic; 0 almost always).
+uint64_t rb_sample(void* base, const uint64_t* indices, uint64_t n,
+                   void* out) {
+    Header* h = header(base);
+    const uint64_t cap = h->capacity;
+    const uint64_t rb = h->row_bytes;
+    std::atomic<uint32_t>* s = seqs(base);
+    char* d = data(base, cap);
+    char* o = static_cast<char*>(out);
+    uint64_t retries = 0;
+    for (uint64_t k = 0; k < n; ++k) {
+        uint64_t i = indices[k];
+        for (;;) {
+            uint32_t before = s[i].load(std::memory_order_acquire);
+            if (before & 1u) {  // write in progress
+                ++retries;
+                continue;
+            }
+            std::atomic_thread_fence(std::memory_order_acquire);
+            std::memcpy(o + k * rb, d + i * rb, rb);
+            std::atomic_thread_fence(std::memory_order_acquire);
+            uint32_t after = s[i].load(std::memory_order_acquire);
+            if (before == after) break;
+            ++retries;
+        }
+    }
+    return retries;
+}
+
+}  // extern "C"

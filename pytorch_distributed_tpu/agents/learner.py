@@ -94,11 +94,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         # host (memory/device_replay.py docstring)
         from pytorch_distributed_tpu.memory.device_replay import sample_rows
 
-        mp_ = opt.memory_params
-        memory.attach(
-            mp_.memory_size, spec.state_shape, spec.action_shape,
-            np.uint8 if mp_.state_dtype == "uint8" else np.float32,
-            spec.action_dtype, mesh=mesh)
+        memory.attach(mesh=mesh)
         fused_step = jax.jit(
             lambda ts, rs, key: step_fn(
                 ts, sample_rows(rs, key, ap.batch_size)),

@@ -41,6 +41,7 @@ EnvsDict: Dict[str, Callable] = {
 
 MemoriesDict: Dict[str, Optional[Callable]] = {
     "shared": SharedReplay,           # reference factory.py:37 "shared"
+    "native": None,                    # C++ lock-free ring (native_ring.py)
     "prioritized": PrioritizedReplay,  # finishes the reference's PER TODO
     "device": None,                    # HBM-resident ring (device_replay.py)
     "none": None,                      # reference factory.py:38
@@ -259,8 +260,23 @@ class MemoryHandles:
 def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
     mp_ = opt.memory_params
     state_dtype = np.uint8 if mp_.state_dtype == "uint8" else np.float32
-    if opt.memory_type == "shared":
-        mem = SharedReplay(
+    if opt.memory_type in ("shared", "native"):
+        ctor = SharedReplay
+        if opt.memory_type == "native":
+            try:
+                from pytorch_distributed_tpu.memory.native_ring import (
+                    NativeRingReplay, get_lib,
+                )
+
+                get_lib()
+                ctor = NativeRingReplay
+            except Exception as e:  # noqa: BLE001 - no toolchain: fall back
+                import warnings
+
+                warnings.warn(f"native ring unavailable ({e}); "
+                              "falling back to Python shared replay",
+                              stacklevel=2)
+        mem = ctor(
             capacity=mp_.memory_size,
             state_shape=spec.state_shape,
             action_shape=spec.action_shape,
@@ -287,7 +303,13 @@ def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
             DeviceReplayIngest,
         )
 
-        ingest = DeviceReplayIngest()
+        ingest = DeviceReplayIngest(
+            capacity=mp_.memory_size,
+            state_shape=spec.state_shape,
+            action_shape=spec.action_shape,
+            state_dtype=state_dtype,
+            action_dtype=spec.action_dtype,
+        )
         return MemoryHandles(actor_side=ingest.make_feeder(),
                              learner_side=ingest)
     raise ValueError(f"unknown memory_type: {opt.memory_type}")

@@ -156,9 +156,17 @@ class DeviceReplayIngest:
     until filled.
     """
 
-    def __init__(self, chunk_size: int = 64, max_queue_chunks: int = 4096):
+    def __init__(self, capacity: int, state_shape: Tuple[int, ...],
+                 action_shape: Tuple[int, ...] = (),
+                 state_dtype=np.uint8, action_dtype=np.int32,
+                 chunk_size: int = 64, max_queue_chunks: int = 4096):
         import multiprocessing as mp
 
+        self.capacity = capacity
+        self.state_shape = tuple(state_shape)
+        self.action_shape = tuple(action_shape)
+        self.state_dtype = np.dtype(state_dtype)
+        self.action_dtype = np.dtype(action_dtype)
         self.chunk_size = chunk_size
         self._q = mp.get_context("spawn").Queue(max_queue_chunks)
         self.replay: Optional[DeviceReplay] = None
@@ -170,10 +178,11 @@ class DeviceReplayIngest:
 
         return QueueFeeder(self._q, chunk)
 
-    def attach(self, capacity: int, state_shape: Tuple[int, ...],
-               action_shape: Tuple[int, ...] = (),
-               state_dtype=np.uint8, action_dtype=np.int32,
-               mesh: Optional[jax.sharding.Mesh] = None) -> DeviceReplay:
+    def attach(self, mesh: Optional[jax.sharding.Mesh] = None
+               ) -> DeviceReplay:
+        """Allocate the HBM ring on the learner's mesh (geometry was fixed
+        at construction by the memory factory)."""
+        capacity = self.capacity
         if mesh is not None:
             # round capacity up so rows split evenly across the dp axis
             # (e.g. the default 50000 on a 32-wide mesh -> 50016)
@@ -187,8 +196,8 @@ class DeviceReplayIngest:
                     f"{rounded} (multiple of mesh dp={ndev})", stacklevel=2)
                 capacity = rounded
         self.replay = DeviceReplay(
-            capacity, state_shape, action_shape, state_dtype, action_dtype,
-            mesh=mesh)
+            capacity, self.state_shape, self.action_shape,
+            self.state_dtype, self.action_dtype, mesh=mesh)
         return self.replay
 
     @property

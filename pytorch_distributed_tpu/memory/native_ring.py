@@ -1,0 +1,139 @@
+"""Native lock-free shared replay (C++ ring + ctypes).
+
+Drop-in alternative to ``SharedReplay`` backed by native/ring_buffer.cpp:
+same cross-process six-array transition plane as the reference
+(core/memories/shared_memory.py), but the coarse global lock the reference
+holds around every feed/sample (reference :37,69-75) is replaced by an
+atomic write cursor + per-row seqlocks — writers never block each other or
+readers, so actor fan-out stops serialising on the replay.  Rows are packed
+into one structured-dtype record so a feed is a single memcpy.
+
+Shared pages come from a spawn-context ``mp.Array`` exactly like the Python
+ring, so handles pickle across process spawns; the C++ side only ever sees
+a raw pointer into the region.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import multiprocessing as mp
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.memory.base import Memory
+from pytorch_distributed_tpu.utils.experience import Batch, Transition
+
+_CTX = mp.get_context("spawn")
+
+
+def _load():
+    # the native/ package sits at the repo root next to this package
+    import os
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from native.build import load_library
+
+    lib = load_library("ring_buffer")
+    u64, p = ctypes.c_uint64, ctypes.c_void_p
+    lib.rb_region_bytes.argtypes = [u64, u64]
+    lib.rb_region_bytes.restype = u64
+    lib.rb_init.argtypes = [p, u64, u64]
+    lib.rb_check.argtypes = [p, u64, u64]
+    lib.rb_check.restype = ctypes.c_int
+    lib.rb_total.argtypes = [p]
+    lib.rb_total.restype = u64
+    lib.rb_size.argtypes = [p]
+    lib.rb_size.restype = u64
+    lib.rb_feed.argtypes = [p, p, u64]
+    lib.rb_sample.argtypes = [p, p, u64, p]
+    lib.rb_sample.restype = u64
+    return lib
+
+
+_LIB = None
+
+
+def get_lib():
+    global _LIB
+    if _LIB is None:
+        _LIB = _load()
+    return _LIB
+
+
+class NativeRingReplay(Memory):
+    def __init__(self, capacity: int, state_shape: Tuple[int, ...],
+                 action_shape: Tuple[int, ...] = (),
+                 state_dtype=np.uint8, action_dtype=np.int32):
+        super().__init__(capacity, state_shape, action_shape,
+                         state_dtype, action_dtype)
+        lib = get_lib()  # raises NativeBuildError without a toolchain
+        self.row_dtype = np.dtype([
+            ("state0", self.state_dtype, self.state_shape),
+            ("action", self.action_dtype, self.action_shape),
+            ("reward", np.float32),
+            ("gamma_n", np.float32),
+            ("state1", self.state_dtype, self.state_shape),
+            ("terminal1", np.float32),
+        ])
+        nbytes = int(lib.rb_region_bytes(capacity, self.row_dtype.itemsize))
+        self._region = _CTX.Array(ctypes.c_char, nbytes, lock=False)
+        lib.rb_init(self._base(), capacity, self.row_dtype.itemsize)
+        self.sample_retries = 0  # torn-read retry diagnostic
+
+    def _base(self) -> int:
+        return ctypes.addressof(self._region)
+
+    # pickles through spawn: mp.Array carries the shared pages; the child
+    # re-checks the header instead of re-initialising
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        assert get_lib().rb_check(self._base(), self.capacity,
+                                  self.row_dtype.itemsize), \
+            "attached region does not match ring geometry"
+
+    # -- Memory interface ---------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return int(get_lib().rb_size(self._base()))
+
+    @property
+    def total_feeds(self) -> int:
+        return int(get_lib().rb_total(self._base()))
+
+    def feed(self, transition: Transition,
+             priority: Optional[float] = None) -> None:
+        row = np.empty(1, dtype=self.row_dtype)
+        for f in Transition._fields:
+            row[0][f] = getattr(transition, f)
+        get_lib().rb_feed(self._base(), row.ctypes.data, 1)
+
+    def feed_batch(self, ts: Transition) -> None:
+        n = len(np.atleast_1d(ts.reward))
+        rows = np.empty(n, dtype=self.row_dtype)
+        for f in Transition._fields:
+            rows[f] = getattr(ts, f)
+        get_lib().rb_feed(self._base(), rows.ctypes.data, n)
+
+    def sample(self, batch_size: int, rng: np.random.Generator) -> Batch:
+        size = self.size
+        assert size > 0, "sampling from empty replay"
+        idx = rng.integers(0, size, size=batch_size).astype(np.uint64)
+        out = np.empty(batch_size, dtype=self.row_dtype)
+        self.sample_retries += int(get_lib().rb_sample(
+            self._base(), idx.ctypes.data, batch_size, out.ctypes.data))
+        return Batch(
+            state0=np.ascontiguousarray(out["state0"]),
+            action=np.ascontiguousarray(out["action"]),
+            reward=np.ascontiguousarray(out["reward"]),
+            gamma_n=np.ascontiguousarray(out["gamma_n"]),
+            state1=np.ascontiguousarray(out["state1"]),
+            terminal1=np.ascontiguousarray(out["terminal1"]),
+            weight=np.ones(batch_size, dtype=np.float32),
+            index=idx.astype(np.int32),
+        )

@@ -75,8 +75,9 @@ def test_device_replay_uint8():
 def test_device_ingest_chunks_and_feeds():
     from pytorch_distributed_tpu.memory.device_replay import DeviceReplayIngest
 
-    ing = DeviceReplayIngest(chunk_size=4)
-    ing.attach(capacity=16, state_shape=(3,), state_dtype=np.float32)
+    ing = DeviceReplayIngest(capacity=16, state_shape=(3,),
+                             state_dtype=np.float32, chunk_size=4)
+    ing.attach()
     feeder = ing.make_feeder(chunk=2)
     for i in range(7):
         feeder.feed(Transition(

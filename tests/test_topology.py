@@ -115,3 +115,18 @@ def test_device_replay_topology_runs(tmp_path):
     recs = read_scalars(opt.log_dir)
     assert any(r["tag"] == "learner/critic_loss" for r in recs)
     assert topo.handles.learner_side.size > 0
+
+
+def test_native_ring_topology_runs(tmp_path):
+    pytest.importorskip("ctypes")
+    try:
+        from pytorch_distributed_tpu.memory.native_ring import get_lib
+        get_lib()
+    except Exception:
+        pytest.skip("native toolchain unavailable")
+    opt = _opts(tmp_path, config=1, memory_type="native", steps=200)
+    topo = runtime.train(opt, backend="thread")
+    assert topo.clock.learner_step.value >= 200
+    from pytorch_distributed_tpu.memory.native_ring import NativeRingReplay
+    assert isinstance(topo.handles.learner_side, NativeRingReplay)
+    assert topo.handles.learner_side.total_feeds > 0
