@@ -29,6 +29,8 @@ def parse_args(argv=None):
                    help="1=train, 2=test (reference main.py:34,107)")
     p.add_argument("--seed", type=int, default=100)
     p.add_argument("--num-actors", type=int, default=None)
+    p.add_argument("--num-envs-per-actor", type=int, default=None,
+                   help="vector-env width per actor (batched inference)")
     p.add_argument("--steps", type=int, default=None,
                    help="max learner steps (reference utils/options.py:119)")
     p.add_argument("--memory-size", type=int, default=None)
@@ -49,6 +51,8 @@ def options_from_args(args):
     overrides = dict(mode=args.mode, seed=args.seed)
     if args.num_actors is not None:
         overrides["num_actors"] = args.num_actors
+    if args.num_envs_per_actor is not None:
+        overrides["num_envs_per_actor"] = args.num_envs_per_actor
     if args.steps is not None:
         overrides["steps"] = args.steps
     if args.memory_size is not None:

@@ -1,33 +1,40 @@
 """Actor processes: asynchronous experience collection.
 
 Re-design of reference core/single_processes/dqn_actor.py and
-ddpg_actor.py.  Same topology — N independent rollout workers, each with a
-full local model replica and its own env, diversified by the Ape-X
-exploration schedule and per-process seed — with the reference's implicit
-shared-CUDA weight pulls replaced by versioned ``ParamStore`` fetches and
-its inline deque bookkeeping replaced by the unit-tested ``NStepAssembler``.
+ddpg_actor.py.  Same topology — rollout workers with a full local model
+replica, diversified by the Ape-X exploration schedule and per-process
+seeds — with two structural upgrades:
 
-Cadences mirror the reference: weight re-sync every ``actor_sync_freq``
-local steps (reference dqn_actor.py:176-178), stats pushed every
-``actor_freq`` steps (reference :180-192), one global actor-step counter
-increment per env step under its lock (reference :166-167), loop until the
-global learner clock reaches ``steps`` (reference :62).
+- the reference's implicit shared-CUDA weight pulls become versioned
+  ``ParamStore`` fetches on the ``actor_sync_freq`` cadence (reference
+  dqn_actor.py:176-178), and its inline deque bookkeeping becomes the
+  unit-tested ``NStepAssembler``;
+- every actor is **vectorized**: it steps ``num_envs_per_actor`` envs with
+  ONE jitted batched forward per tick (envs/vector.py) — the reference
+  reserves this knob but asserts it to 1 (reference utils/options.py:32);
+  batch-1 inference is the latency wall SURVEY.md §7 flags, and batching is
+  how a TPU-host actor feeds the learner fast enough.  N=1 degenerates to
+  the reference's exact per-step loop.
 
-Inference is a jitted host-side forward (the actor process pins JAX to CPU
-via the runtime trampoline), so per-step latency has no device round-trip —
-the answer to the reference's latency-bound batch-1 CUDA forward
-(SURVEY.md §7 "hard parts").
+Cadences mirror the reference: stats pushed every ``actor_freq`` env steps
+(reference dqn_actor.py:180-192), global actor-step counter advanced per
+env step (reference :166-167), loop until the global learner clock reaches
+``steps`` (reference :62).
+
+Exploration diversity follows Ape-X across the whole fleet: env ``j`` of
+actor ``i`` takes exploration slot ``i*N + j`` of ``num_actors*N``
+(reference dqn_actor.py:33-36 has one slot per actor).
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, List
 
 import numpy as np
 
 from pytorch_distributed_tpu.config import Options
 from pytorch_distributed_tpu.factory import (
-    EnvSpec, build_env, build_model, ddpg_applies, init_params,
+    EnvSpec, build_env_vector, build_model, init_params,
 )
 from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
 from pytorch_distributed_tpu.agents.param_store import (
@@ -41,8 +48,8 @@ from pytorch_distributed_tpu.utils.rngs import process_key, process_seed
 
 
 class _ActorHarness:
-    """Shared plumbing for both actor families: env/model/params setup,
-    n-step feed, stat accumulation, sync cadence."""
+    """Shared plumbing for both actor families: vector env + model/param
+    setup, per-env n-step feeds, stat accumulation, sync cadence."""
 
     def __init__(self, opt: Options, spec: EnvSpec, process_ind: int,
                  memory: Any, param_store: ParamStore, clock: GlobalClock,
@@ -56,7 +63,8 @@ class _ActorHarness:
         self.clock = clock
         self.stats = stats
 
-        self.env = build_env(opt, process_ind)
+        self.num_envs = max(1, opt.env_params.num_envs_per_actor)
+        self.env = build_env_vector(opt, process_ind, self.num_envs)
         self.env.train()
         self.model = build_model(opt, spec)
         params0 = init_params(opt, spec, self.model, seed=process_seed(
@@ -67,38 +75,67 @@ class _ActorHarness:
         # (reference dqn_actor.py:26-30)
         flat, self.version = param_store.wait(0, stop=clock.stop)
         self.params = self.unravel(flat)
-        self.assembler = NStepAssembler(self.ap.nstep, self.ap.gamma)
 
-        # local stat accumulators, flushed every actor_freq steps
+        N = self.num_envs
+        self.assemblers: List[NStepAssembler] = [
+            NStepAssembler(self.ap.nstep, self.ap.gamma) for _ in range(N)]
+        self.episode_steps = np.zeros(N, dtype=np.int64)
+        self.episode_reward = np.zeros(N, dtype=np.float64)
+
+        # local stat accumulators, flushed every actor_freq env steps
         self._acc = dict.fromkeys(ActorStats.FIELDS, 0.0)
-        self.local_step = 0
+        self.env_steps = 0
+        self._next_flush = self.ap.actor_freq
+        self._next_sync = self.ap.actor_sync_freq
 
-    # -- cadence hooks ------------------------------------------------------
+    # -- one vector tick ----------------------------------------------------
 
-    def maybe_sync(self) -> None:
-        if self.local_step % self.ap.actor_sync_freq == 0:
+    def advance(self, actions, next_obs, rewards, terminals, infos) -> None:
+        """Feed assemblers/memory for one batched env step and run every
+        cadence (counter, stats, weight sync)."""
+        for j in range(self.num_envs):
+            true_next = infos[j].get("final_obs", next_obs[j])
+            truncated = bool(infos[j].get("truncated", False))
+            transitions = self.assemblers[j].feed(
+                self._obs[j], actions[j], float(rewards[j]), true_next,
+                bool(terminals[j]), truncated=truncated)
+            for t in transitions:
+                self.memory.feed(t, None)
+            self.episode_steps[j] += 1
+            self.episode_reward[j] += float(rewards[j])
+            if terminals[j]:
+                solved = bool(infos[j].get(
+                    "solved", self.episode_reward[j] > 0))
+                self._acc["nepisodes"] += 1
+                self._acc["nepisodes_solved"] += float(solved)
+                self._acc["total_steps"] += float(self.episode_steps[j])
+                self._acc["total_reward"] += float(self.episode_reward[j])
+                self.episode_steps[j] = 0
+                self.episode_reward[j] = 0.0
+                self.on_env_reset(j)
+        self._obs = next_obs
+
+        N = self.num_envs
+        self.env_steps += N
+        self.clock.add_actor_steps(N)  # reference dqn_actor.py:166-167
+        self._acc["total_nframes"] += N
+        if self.env_steps >= self._next_flush:
+            self._next_flush += self.ap.actor_freq
+            self.flush_stats()
+            if hasattr(self.memory, "flush"):
+                self.memory.flush()  # queue feeders drain on the cadence
+        if self.env_steps >= self._next_sync:
+            self._next_sync += self.ap.actor_sync_freq
             got = self.param_store.fetch(self.version)
             if got is not None:
                 flat, self.version = got
                 self.params = self.unravel(flat)
 
-    def push_step(self, transitions) -> None:
-        for t in transitions:
-            self.memory.feed(t, None)
-        self.local_step += 1
-        self.clock.add_actor_steps(1)
-        self._acc["total_nframes"] += 1
-        if self.local_step % self.ap.actor_freq == 0:
-            self.flush_stats()
+    def start(self) -> None:
+        self._obs = self.env.reset()
 
-    def end_episode(self, episode_steps: int, episode_reward: float,
-                    solved: bool) -> None:
-        self._acc["nepisodes"] += 1
-        self._acc["nepisodes_solved"] += float(solved)
-        self._acc["total_steps"] += episode_steps
-        self._acc["total_reward"] += episode_reward
-        if hasattr(self.memory, "flush"):
-            self.memory.flush()  # queue feeders drain at episode ends
+    def on_env_reset(self, j: int) -> None:
+        """Hook for per-env exploration state (DDPG OU paths)."""
 
     def flush_stats(self) -> None:
         if any(self._acc.values()):
@@ -114,59 +151,53 @@ class _ActorHarness:
 def run_dqn_actor(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                   param_store: ParamStore, clock: GlobalClock,
                   stats: ActorStats) -> None:
-    """eps-greedy rollout worker (reference dqn_actor.py:9-192)."""
+    """eps-greedy rollout worker (reference dqn_actor.py:9-192), batched
+    over the actor's env vector."""
     import jax
 
     from pytorch_distributed_tpu.models.policies import (
-        apex_epsilon, build_epsilon_greedy_act,
+        apex_epsilons, build_epsilon_greedy_act,
     )
 
     h = _ActorHarness(opt, spec, process_ind, memory, param_store, clock,
                       stats)
     act = build_epsilon_greedy_act(h.model.apply)
-    eps = apex_epsilon(process_ind, opt.num_actors,
-                       h.ap.eps, h.ap.eps_alpha)
+    eps = apex_epsilons(process_ind, opt.num_actors, h.num_envs,
+                        h.ap.eps, h.ap.eps_alpha)
     key = process_key(opt.seed, "actor", process_ind)
 
-    obs = h.env.reset()
-    episode_steps, episode_reward = 0, 0.0
+    h.start()
     while not clock.done(h.ap.steps):
         key, sub = jax.random.split(key)
-        a, _q_sel, _q_max = act(h.params, obs[None], sub, eps)
-        a = int(a[0])
-        next_obs, r, terminal, info = h.env.step(a)
-        transitions = h.assembler.feed(
-            obs, a, r, next_obs, terminal,
-            truncated=bool(info.get("truncated", False)))
-        h.push_step(transitions)
-        episode_steps += 1
-        episode_reward += float(r)
-        obs = next_obs
-        if terminal:
-            h.end_episode(episode_steps, episode_reward,
-                          solved=bool(info.get("solved",
-                                               episode_reward > 0)))
-            obs = h.env.reset()
-            episode_steps, episode_reward = 0, 0.0
-        h.maybe_sync()
+        a, _q_sel, _q_max = act(h.params, h._obs, sub, eps)
+        actions = np.asarray(a)
+        next_obs, rewards, terminals, infos = h.env.step(actions)
+        h.advance(actions, next_obs, rewards, terminals, infos)
     h.shutdown()
 
 
 def run_ddpg_actor(opt: Options, spec: EnvSpec, process_ind: int,
                    memory: Any, param_store: ParamStore, clock: GlobalClock,
                    stats: ActorStats) -> None:
-    """OU-noise rollout worker (reference ddpg_actor.py:9-172): same skeleton
-    as the DQN actor with one process-local OrnsteinUhlenbeckProcess
-    (theta/sigma from AgentParams, anneal over memory_size*100 steps —
-    reference ddpg_actor.py:34-35)."""
-    h = _ActorHarness(opt, spec, process_ind, memory, param_store, clock,
-                      stats)
+    """OU-noise rollout worker (reference ddpg_actor.py:9-172): same
+    skeleton with one OrnsteinUhlenbeckProcess state per env (theta/sigma
+    from AgentParams, anneal over memory_size*100 steps — reference
+    ddpg_actor.py:34-35)."""
     from pytorch_distributed_tpu.models.policies import build_ddpg_act
 
+    class _DdpgHarness(_ActorHarness):
+        ou: OrnsteinUhlenbeckProcess  # set right after construction
+
+        def on_env_reset(self, j: int) -> None:
+            # fresh noise path per episode, per env
+            self.ou.x_prev.reshape(self.num_envs, -1)[j] = self.ou.x0
+
+    h = _DdpgHarness(opt, spec, process_ind, memory, param_store, clock,
+                     stats)
     act = build_ddpg_act(lambda p, o: h.model.apply(
         p, o, method=h.model.forward_actor))
-    ou = OrnsteinUhlenbeckProcess(
-        size=spec.action_dim,
+    h.ou = ou = OrnsteinUhlenbeckProcess(
+        size=h.num_envs * spec.action_dim,
         theta=h.ap.ou_theta,
         mu=h.ap.ou_mu,
         sigma=h.ap.ou_sigma,
@@ -174,26 +205,11 @@ def run_ddpg_actor(opt: Options, spec: EnvSpec, process_ind: int,
         seed=process_seed(opt.seed, "actor", process_ind) + 17,
     )
 
-    obs = h.env.reset()
-    ou.reset_states()
-    episode_steps, episode_reward = 0, 0.0
+    h.start()
     while not clock.done(h.ap.steps):
-        a = np.asarray(act(h.params, obs[None]))[0]
-        a = np.clip(a + ou.sample(), -1.0, 1.0).astype(np.float32)
-        next_obs, r, terminal, info = h.env.step(a)
-        transitions = h.assembler.feed(
-            obs, a, r, next_obs, terminal,
-            truncated=bool(info.get("truncated", False)))
-        h.push_step(transitions)
-        episode_steps += 1
-        episode_reward += float(r)
-        obs = next_obs
-        if terminal:
-            h.end_episode(episode_steps, episode_reward,
-                          solved=bool(info.get("solved",
-                                               episode_reward > 0)))
-            obs = h.env.reset()
-            ou.reset_states()  # fresh noise path per episode
-            episode_steps, episode_reward = 0, 0.0
-        h.maybe_sync()
+        a = np.asarray(act(h.params, h._obs))
+        noise = ou.sample().reshape(h.num_envs, spec.action_dim)
+        actions = np.clip(a + noise, -1.0, 1.0).astype(np.float32)
+        next_obs, rewards, terminals, infos = h.env.step(actions)
+        h.advance(actions, next_obs, rewards, terminals, infos)
     h.shutdown()

@@ -68,7 +68,10 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                   param_store: ParamStore, clock: GlobalClock,
                   stats: EvaluatorStats) -> None:
     ap = opt.agent_params
-    env = build_env(opt, process_ind=opt.num_actors + 1)
+    # seed slot past the whole actor fleet (actors hold slots
+    # 0 .. num_actors*num_envs_per_actor - 1)
+    fleet = opt.num_actors * max(1, opt.env_params.num_envs_per_actor)
+    env = build_env(opt, process_ind=fleet + 1)
     env.eval()  # standard episode boundaries (reference evaluators.py:19)
     model = build_model(opt, spec)
     params0 = init_params(opt, spec, model, seed=process_seed(

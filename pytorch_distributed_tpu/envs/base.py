@@ -53,9 +53,13 @@ class Env:
     def __init__(self, env_params, process_ind: int = 0):
         self.params = env_params
         self.process_ind = process_ind
-        # Per-process seeding, same scheme as reference
-        # core/envs/atari_env.py:16.
-        self.seed = env_params.seed + process_ind * env_params.num_envs_per_actor
+        # Per-instance seeding: ``process_ind`` is a global env SLOT —
+        # actor i's env j passes slot i*N+j (factory.build_env_vector), the
+        # evaluator a slot past the whole actor fleet.  Same intent as the
+        # reference's ``seed + process_ind * num_envs_per_actor``
+        # (reference core/envs/atari_env.py:16, where N is asserted 1);
+        # slot-based avoids double-scaling when N > 1.
+        self.seed = env_params.seed + process_ind
         self.rng = np.random.default_rng(self.seed)
         self.training = True
         # norm_val divides raw observations inside the model forward

@@ -110,6 +110,17 @@ def build_env(opt: Options, process_ind: int = 0):
     return ctor(opt.env_params, process_ind)
 
 
+def build_env_vector(opt: Options, process_ind: int, num_envs: int):
+    """N env instances as one batched VectorEnv; env j of actor i gets the
+    distinct seed slot i*N + j (the reference's per-process scheme,
+    reference atari_env.py:16, extended over the env axis)."""
+    from pytorch_distributed_tpu.envs.vector import VectorEnv
+
+    ctor = EnvsDict[opt.env_type]
+    return VectorEnv([ctor(opt.env_params, process_ind * num_envs + j)
+                      for j in range(num_envs)])
+
+
 def probe_env(opt: Options) -> EnvSpec:
     """Instantiate a throwaway env to read shapes (reference main.py:23-31)."""
     env = build_env(opt, process_ind=0)

@@ -168,6 +168,16 @@ class DeviceReplayIngest:
         self.state_dtype = np.dtype(state_dtype)
         self.action_dtype = np.dtype(action_dtype)
         self.chunk_size = chunk_size
+        # Ingest sizes, largest-first: a deep backlog moves in few large
+        # transfers (one jit trace per size) instead of many chunk_size
+        # ones — host->device transfer count, not bytes, is what stalls a
+        # learner step when actors outpace it.  Capped at capacity: a chunk
+        # larger than the ring would scatter duplicate indices, whose
+        # winner XLA leaves unspecified.
+        self.chunk_sizes = tuple(sorted(
+            {min(s, capacity)
+             for s in (chunk_size, chunk_size * 8, chunk_size * 64)},
+            reverse=True))
         self._q = mp.get_context("spawn").Queue(max_queue_chunks)
         self.replay: Optional[DeviceReplay] = None
         self._pending: list = []
@@ -206,7 +216,11 @@ class DeviceReplayIngest:
         assert self.replay is not None, "attach() first"
         return min(self._fed_total, self.replay.capacity)
 
-    def drain(self, max_chunks: int = 1024) -> int:
+    def drain(self, max_chunks: int = 1024,
+              max_rows: int = 32768) -> int:
+        """Move queued transitions into HBM; bounded by ``max_rows`` per
+        call so a deep backlog cannot stall the learner's update cadence —
+        leftover rows carry to the next step's drain."""
         from pytorch_distributed_tpu.memory.feeder import pop_chunks
         from pytorch_distributed_tpu.utils.experience import (
             transition_dtypes,
@@ -216,10 +230,13 @@ class DeviceReplayIngest:
         self._pending.extend(
             t for t, _priority in pop_chunks(self._q, max_chunks))
         fed = 0
-        C = self.chunk_size
         dt = transition_dtypes(self.replay.state_dtype,
                                self.replay.action_dtype)
-        while len(self._pending) >= C:
+        while fed < max_rows:
+            C = next((s for s in self.chunk_sizes
+                      if s <= len(self._pending)), None)
+            if C is None:
+                break
             rows, self._pending = self._pending[:C], self._pending[C:]
             chunk = Transition(*(
                 np.stack([getattr(r, f) for r in rows]).astype(dt[f])

@@ -30,14 +30,29 @@ def apex_epsilon(process_ind: int, num_actors: int,
     return float(eps ** (1.0 + frac * eps_alpha))
 
 
+def apex_epsilons(process_ind: int, num_actors: int, num_envs: int,
+                  eps: float = 0.4, eps_alpha: float = 7.0):
+    """Per-env epsilon vector for a vectorized actor: env j of actor i
+    takes fleet slot i*num_envs + j of num_actors*num_envs, so exploration
+    diversity spans the whole fleet exactly as the reference's per-actor
+    schedule spans its actors (reference dqn_actor.py:33-36)."""
+    import numpy as np
+
+    total = num_actors * num_envs
+    return np.asarray(
+        [apex_epsilon(process_ind * num_envs + j, total, eps, eps_alpha)
+         for j in range(num_envs)], dtype=np.float32)
+
+
 def build_epsilon_greedy_act(apply_fn: Callable) -> Callable:
     """eps-greedy over a Q-network.
 
     Returns a jitted ``act(params, obs[B,...], key, eps) ->
-    (action[B], q_sel[B], q_max[B])``; q_sel/q_max feed PER initial
-    priorities, mirroring the tuple the reference returns when PER is on
-    (reference dqn_cnn_model.py:65-78) — here they are always returned
-    (cost-free under jit).
+    (action[B], q_sel[B], q_max[B])``; ``eps`` may be a scalar or a (B,)
+    per-sample vector (the vectorized-actor fleet schedule).  q_sel/q_max
+    feed PER initial priorities, mirroring the tuple the reference returns
+    when PER is on (reference dqn_cnn_model.py:65-78) — here they are
+    always returned (cost-free under jit).
     """
 
     def act(params, obs, key, eps):

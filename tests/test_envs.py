@@ -134,3 +134,40 @@ def test_atari_gated_import_error():
     with pytest.raises(ImportError):
         from pytorch_distributed_tpu.envs.atari import AtariEnv
         AtariEnv(_params(0))
+
+
+def test_vector_env_auto_reset_and_final_obs():
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.factory import build_env_vector
+
+    opt = build_options(config=1)
+    v = build_env_vector(opt, process_ind=0, num_envs=3)
+    v.train()
+    obs = v.reset()
+    assert obs.shape[0] == 3
+    # drive env 0 to terminal (always-right on the 8-chain: 7 steps)
+    for _ in range(7):
+        nobs, r, term, infos = v.step([1, 0, 0])
+    assert term[0] and not term[1] and not term[2]
+    # terminal env auto-reset: returned obs is the reset obs, true terminal
+    # frame rides in final_obs
+    assert "final_obs" in infos[0]
+    assert nobs[0][0] == 1.0            # reset to chain position 0
+    assert infos[0]["final_obs"][-1] == 1.0  # terminal = right end
+    # distinct seeds per env slot
+    seeds = {e.seed for e in v.envs}
+    assert len(seeds) == 3
+
+
+def test_apex_epsilons_span_fleet():
+    from pytorch_distributed_tpu.models.policies import (
+        apex_epsilon, apex_epsilons,
+    )
+
+    # 2 actors x 4 envs == the 8-slot schedule of 8 plain actors
+    a0 = apex_epsilons(0, 2, 4)
+    a1 = apex_epsilons(1, 2, 4)
+    flat = list(a0) + list(a1)
+    want = [apex_epsilon(i, 8) for i in range(8)]
+    import numpy as np
+    np.testing.assert_allclose(flat, want, rtol=1e-6)

@@ -130,3 +130,14 @@ def test_native_ring_topology_runs(tmp_path):
     from pytorch_distributed_tpu.memory.native_ring import NativeRingReplay
     assert isinstance(topo.handles.learner_side, NativeRingReplay)
     assert topo.handles.learner_side.total_feeds > 0
+
+
+def test_vector_env_actor_topology(tmp_path):
+    opt = _opts(tmp_path, config=1, steps=300, num_actors=1,
+                num_envs_per_actor=4)
+    topo = runtime.train(opt, backend="thread")
+    assert topo.clock.learner_step.value >= 300
+    # 4 envs advance the actor clock 4 per tick
+    assert topo.clock.actor_step.value >= 4
+    recs = read_scalars(opt.log_dir)
+    assert any(r["tag"] == "actor/avg_reward" for r in recs)
