@@ -37,6 +37,8 @@ def parse_args(argv=None):
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--nstep", type=int, default=None)
     p.add_argument("--enable-double", action="store_true")
+    p.add_argument("--publish-freq", type=int, default=None,
+                   help="learner steps between param publications")
     p.add_argument("--model-file", type=str, default=None,
                    help="finetune (mode 1) / test (mode 2) checkpoint")
     p.add_argument("--backend", choices=("process", "thread"),
@@ -63,6 +65,8 @@ def options_from_args(args):
         overrides["nstep"] = args.nstep
     if args.enable_double:
         overrides["enable_double"] = True
+    if args.publish_freq is not None:
+        overrides["param_publish_freq"] = args.publish_freq
     if args.model_file is not None:
         overrides["model_file"] = args.model_file
     if args.no_tensorboard:

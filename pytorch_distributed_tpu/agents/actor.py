@@ -82,25 +82,56 @@ class _ActorHarness:
         self.episode_steps = np.zeros(N, dtype=np.int64)
         self.episode_reward = np.zeros(N, dtype=np.float64)
 
+        # Actor-computed initial PER priorities (the plumbing the reference
+        # anticipated but never finished, reference dqn_actor.py:113-115):
+        # per env, q_sel of each acted step FIFO-aligned with the
+        # assembler's FIFO emissions, plus a one-tick holding pen for
+        # steady-state emissions whose bootstrap state's q_max only becomes
+        # known at the NEXT tick's batched forward.
+        from collections import deque
+
+        self.per_priorities = (opt.memory_params.enable_per
+                               and opt.agent_type == "dqn")
+        self._q_hist = [deque() for _ in range(N)]
+        self._q_pending: List[list] = [[] for _ in range(N)]
+
         # local stat accumulators, flushed every actor_freq env steps
         self._acc = dict.fromkeys(ActorStats.FIELDS, 0.0)
         self.env_steps = 0
         self._next_flush = self.ap.actor_freq
         self._next_sync = self.ap.actor_sync_freq
 
+        from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+        from pytorch_distributed_tpu.utils.profiling import StepTimer
+
+        self.timer = StepTimer("actor")
+        self._timing_writer = MetricsWriter(opt.log_dir,
+                                            enable_tensorboard=False)
+
     # -- one vector tick ----------------------------------------------------
 
-    def advance(self, actions, next_obs, rewards, terminals, infos) -> None:
+    def advance(self, actions, next_obs, rewards, terminals, infos,
+                q_sel=None, q_max=None) -> None:
         """Feed assemblers/memory for one batched env step and run every
-        cadence (counter, stats, weight sync)."""
+        cadence (counter, stats, weight sync).  ``q_sel``/``q_max`` are this
+        tick's per-env Q diagnostics from the batched forward (DQN actors);
+        with PER enabled they become initial priorities."""
+        if self.per_priorities:
+            self._resolve_pending(q_max)
         for j in range(self.num_envs):
             true_next = infos[j].get("final_obs", next_obs[j])
             truncated = bool(infos[j].get("truncated", False))
+            if self.per_priorities:
+                self._q_hist[j].append(float(q_sel[j]))
             transitions = self.assemblers[j].feed(
                 self._obs[j], actions[j], float(rewards[j]), true_next,
                 bool(terminals[j]), truncated=truncated)
-            for t in transitions:
-                self.memory.feed(t, None)
+            if self.per_priorities:
+                self._feed_with_priorities(j, transitions,
+                                           bool(terminals[j]), truncated)
+            else:
+                for t in transitions:
+                    self.memory.feed(t, None)
             self.episode_steps[j] += 1
             self.episode_reward[j] += float(rewards[j])
             if terminals[j]:
@@ -122,6 +153,8 @@ class _ActorHarness:
         if self.env_steps >= self._next_flush:
             self._next_flush += self.ap.actor_freq
             self.flush_stats()
+            self._timing_writer.scalars(self.timer.drain(),
+                                        step=self.clock.learner_step.value)
             if hasattr(self.memory, "flush"):
                 self.memory.flush()  # queue feeders drain on the cadence
         if self.env_steps >= self._next_sync:
@@ -130,6 +163,41 @@ class _ActorHarness:
             if got is not None:
                 flat, self.version = got
                 self.params = self.unravel(flat)
+
+    # -- actor-side TD-error priorities (PER) -------------------------------
+
+    def _resolve_pending(self, q_max) -> None:
+        """Steady-state emissions held from the previous tick bootstrap
+        from the state the actor is looking at NOW — its q_max just arrived
+        with this tick's forward.  priority = |R + gamma_m * maxQ(s_end) -
+        q_sel(s_t)|, the n-step TD estimate under the actor's weights."""
+        for j in range(self.num_envs):
+            if not self._q_pending[j]:
+                continue
+            for t, q_t in self._q_pending[j]:
+                pr = abs(float(t.reward)
+                         + float(t.gamma_n) * float(q_max[j]) - q_t)
+                self.memory.feed(t, pr)
+            self._q_pending[j] = []
+
+    def _feed_with_priorities(self, j: int, transitions,
+                              terminal: bool, truncated: bool) -> None:
+        if terminal or truncated:
+            # episode boundary: every window closed this tick.  True
+            # terminals have a zero bootstrap so the TD estimate needs no
+            # future q; truncated tails would need q(final_obs), which was
+            # never computed — they take the standard new-sample max
+            # priority (None).
+            for t in transitions:
+                q_t = self._q_hist[j].popleft()
+                if truncated:
+                    self.memory.feed(t, None)
+                else:
+                    self.memory.feed(t, abs(float(t.reward) - q_t))
+            self._q_hist[j].clear()  # next episode starts a fresh history
+        else:
+            for t in transitions:  # bootstrap q arrives next tick
+                self._q_pending[j].append((t, self._q_hist[j].popleft()))
 
     def start(self) -> None:
         self._obs = self.env.reset()
@@ -143,9 +211,14 @@ class _ActorHarness:
             self._acc = dict.fromkeys(ActorStats.FIELDS, 0.0)
 
     def shutdown(self) -> None:
+        for j in range(self.num_envs):  # unresolved holds: max priority
+            for t, _q in self._q_pending[j]:
+                self.memory.feed(t, None)
+            self._q_pending[j] = []
         self.flush_stats()
         if hasattr(self.memory, "flush"):
             self.memory.flush()
+        self._timing_writer.close()
 
 
 def run_dqn_actor(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
@@ -168,11 +241,15 @@ def run_dqn_actor(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
 
     h.start()
     while not clock.done(h.ap.steps):
-        key, sub = jax.random.split(key)
-        a, _q_sel, _q_max = act(h.params, h._obs, sub, eps)
-        actions = np.asarray(a)
-        next_obs, rewards, terminals, infos = h.env.step(actions)
-        h.advance(actions, next_obs, rewards, terminals, infos)
+        with h.timer.phase("act"):
+            key, sub = jax.random.split(key)
+            a, q_sel, q_max = act(h.params, h._obs, sub, eps)
+            actions = np.asarray(a)
+        with h.timer.phase("env"):
+            next_obs, rewards, terminals, infos = h.env.step(actions)
+        with h.timer.phase("advance"):
+            h.advance(actions, next_obs, rewards, terminals, infos,
+                      q_sel=np.asarray(q_sel), q_max=np.asarray(q_max))
     h.shutdown()
 
 

@@ -38,9 +38,13 @@ from pytorch_distributed_tpu.agents.clocks import GlobalClock, LearnerStats
 from pytorch_distributed_tpu.agents.param_store import (
     ParamStore, make_flattener,
 )
-from pytorch_distributed_tpu.memory.device_replay import DeviceReplayIngest
+from pytorch_distributed_tpu.memory.device_replay import (
+    DevicePerIngest, DeviceReplayIngest,
+)
 from pytorch_distributed_tpu.memory.feeder import QueueOwner
 from pytorch_distributed_tpu.utils import checkpoint as ckpt
+from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+from pytorch_distributed_tpu.utils.profiling import StepTimer
 from pytorch_distributed_tpu.utils.rngs import np_rng
 
 
@@ -86,21 +90,43 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     _publish(state)
 
     is_per = isinstance(memory, QueueOwner)
-    is_device = isinstance(memory, DeviceReplayIngest)
-    if is_device:
-        # attach the HBM ring on the learner's mesh and fuse sampling into
-        # the train step: one XLA program does gather-from-ring + forward +
-        # backward + Adam + target update, so the hot loop never touches the
-        # host (memory/device_replay.py docstring)
-        from pytorch_distributed_tpu.memory.device_replay import sample_rows
+    is_device_per = isinstance(memory, DevicePerIngest)
+    is_device = isinstance(memory, DeviceReplayIngest) and not is_device_per
+    on_device = is_device or is_device_per
+    if on_device:
+        # Attach the HBM ring on the learner's mesh and fuse sampling (and
+        # for PER: priority write-back) into the train step — one XLA
+        # program per update, no host touch in the hot loop
+        # (memory/device_replay.py, memory/device_per.py docstrings).
+        replay = memory.attach(mesh=mesh)
+        beta_dev = None
+        if is_device_per:
+            fused_per = replay.build_fused_step(step_fn, ap.batch_size,
+                                                donate=pp.donate)
 
-        memory.attach(mesh=mesh)
-        fused_step = jax.jit(
-            lambda ts, rs, key: step_fn(
-                ts, sample_rows(rs, key, ap.batch_size)),
-            donate_argnums=(0,) if pp.donate else ())
+            def device_step(key):
+                nonlocal state
+                state, replay.state, m = fused_per(state, replay.state,
+                                                   key, beta_dev)
+                return m
+        else:
+            from pytorch_distributed_tpu.memory.device_replay import (
+                sample_rows,
+            )
+
+            fused = jax.jit(
+                lambda ts, rs, key: step_fn(
+                    ts, sample_rows(rs, key, ap.batch_size)),
+                donate_argnums=(0,) if pp.donate else ())
+
+            def device_step(key):
+                nonlocal state
+                state, m, _td = fused(state, replay.state, key)
+                return m
+
         device_key = jax.random.PRNGKey(
             np_rng(opt.seed, "learner", process_ind).integers(2 ** 31))
+        key_buf: list = []  # pre-split sampling keys, one dispatch per 64
         # the CPU backend's collective rendezvous needs per-step blocking
         # (see ShardedLearner.step)
         block_each_step = (mesh is not None
@@ -117,41 +143,63 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     while not clock.done(ap.steps) and memory_size(memory) <= learn_start:
         time.sleep(0.05)
 
-    # metric refs are collected per step without forcing a device sync and
-    # converted to floats only on the learner_freq cadence
-    pending_metrics = []
+    # the latest step's metric refs, fetched to host only on the
+    # learner_freq cadence (one device_get per window — per-step or
+    # per-element fetches are round trips that throttle a tunnelled chip)
+    last_metrics = None
     t_cadence = time.monotonic()
+    timer = StepTimer("learner")
+    # per-phase timings go straight to the run's JSONL stream (appends are
+    # atomic line writes; the logger process keeps the aggregated scalars)
+    timing_writer = MetricsWriter(opt.log_dir, enable_tensorboard=False)
 
     while lstep < ap.steps and not clock.stop.is_set():
-        if is_device:
-            memory.drain()
-            device_key, sub = jax.random.split(device_key)
-            state, metrics, td_abs = fused_step(state, memory.replay.state,
-                                                sub)
-            if block_each_step:
-                jax.block_until_ready(state.params)
+        if on_device:
+            with timer.phase("drain"):
+                memory.drain()
+            if not key_buf:
+                # one split dispatch amortised over 64 steps — a per-step
+                # split is a device round trip that dominates when the
+                # chip sits behind a network tunnel; beta (PER) anneals
+                # slowly and refreshes on the same cadence
+                keys = jax.random.split(device_key, 65)
+                device_key = keys[0]
+                key_buf = list(keys[1:])
+                if is_device_per:
+                    beta_dev = jax.device_put(
+                        np.float32(replay.beta(lstep)))
+            with timer.phase("step"):
+                metrics = device_step(key_buf.pop())
+                if block_each_step:
+                    jax.block_until_ready(state.params)
         else:
             if is_per:
-                memory.drain()
-            batch = memory.sample(ap.batch_size, rng)
-            state, metrics, td_abs = learner.step(state, batch)
+                with timer.phase("drain"):
+                    memory.drain()
+            with timer.phase("sample"):
+                batch = memory.sample(ap.batch_size, rng)
+            with timer.phase("step"):
+                state, metrics, td_abs = learner.step(state, batch)
             if is_per:
-                memory.update_priorities(np.asarray(batch.index),
-                                         np.asarray(td_abs))
+                with timer.phase("priorities"):
+                    memory.update_priorities(np.asarray(batch.index),
+                                             np.asarray(td_abs))
         lstep += 1
         clock.set_learner_step(lstep)  # reference dqn_learner.py:94-95
-        pending_metrics.append(metrics)
+        last_metrics = metrics
 
         if lstep % ap.param_publish_freq == 0:
-            _publish(state)
+            with timer.phase("publish"):
+                _publish(state)
         if ap.checkpoint_freq and lstep % ap.checkpoint_freq == 0:
             ckpt.save_train_state(opt.model_name, state)
 
         if lstep % ap.learner_freq == 0:  # reference dqn_learner.py:99-101
             now = time.monotonic()
-            vals = {k: float(np.mean([float(m[k]) for m in pending_metrics]))
-                    for k in pending_metrics[-1]}
-            pending_metrics = []
+            # sampled (not averaged) losses: the window's last step stands
+            # in for the window, one host fetch total
+            vals = {k: float(v)
+                    for k, v in jax.device_get(last_metrics).items()}
             stats.add(
                 counter=1,
                 critic_loss=vals.get("learner/critic_loss", 0.0),
@@ -160,11 +208,13 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                 grad_norm=vals.get("learner/grad_norm", 0.0),
                 steps_per_sec=ap.learner_freq / max(now - t_cadence, 1e-9),
             )
+            timing_writer.scalars(timer.drain(), step=lstep)
             t_cadence = now
 
     # final publication + full-state checkpoint so a next run can resume
     _publish(state)
     ckpt.save_train_state(opt.model_name, state)
+    timing_writer.close()
 
 
 def memory_size(memory: Any) -> int:

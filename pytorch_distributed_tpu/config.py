@@ -45,6 +45,10 @@ CONFIGS = [
     ["dqn",       "pong-sim",  "pong",        "prioritized", "dqn-cnn"], # 6 PER
     ["dqn",       "atari",     "pong",        "prioritized", "dqn-cnn"], # 7 PER on ALE
     ["dqn",       "pong-sim",  "pong",        "device",      "dqn-cnn"], # 8 HBM replay (flagship TPU)
+    ["ddpg",      "gym",       "halfcheetah", "shared",      "ddpg-mlp"],# 9  (BASELINE config 4; needs gym+mujoco)
+    ["ddpg",      "gym",       "humanoid",    "shared",      "ddpg-mlp"],# 10 (BASELINE config 5; needs gym+mujoco)
+    ["dqn",       "atari",     "breakout",    "device",      "dqn-cnn"], # 11 Atari-57 sweep row (needs ALE)
+    ["dqn",       "pong-sim",  "pong",        "device-per",  "dqn-cnn"], # 12 HBM PER, fully fused
 ]
 
 

@@ -153,3 +153,22 @@ class AtariEnv(Env):
                 self.just_died = True
             self.lives = new_lives
         return self._stacked(), float(reward), terminal, info
+
+
+# The canonical 57-game Atari benchmark suite (ALE game ids), for sweep
+# tooling over CONFIGS row 11 (BASELINE.md tracked config 3: "DQN Breakout
+# + Atari-57, 256 actors") — pass any of these as ``game``.
+ATARI57 = (
+    "alien", "amidar", "assault", "asterix", "asteroids", "atlantis",
+    "bank_heist", "battle_zone", "beam_rider", "berzerk", "bowling",
+    "boxing", "breakout", "centipede", "chopper_command", "crazy_climber",
+    "defender", "demon_attack", "double_dunk", "enduro", "fishing_derby",
+    "freeway", "frostbite", "gopher", "gravitar", "hero", "ice_hockey",
+    "jamesbond", "kangaroo", "krull", "kung_fu_master",
+    "montezuma_revenge", "ms_pacman", "name_this_game", "phoenix",
+    "pitfall", "pong", "private_eye", "qbert", "riverraid", "road_runner",
+    "robotank", "seaquest", "skiing", "solaris", "space_invaders",
+    "star_gunner", "surround", "tennis", "time_pilot", "tutankham",
+    "up_n_down", "venture", "video_pinball", "wizard_of_wor",
+    "yars_revenge", "zaxxon",
+)

@@ -1,0 +1,115 @@
+"""Gym/Gymnasium adapter env (gated on the package being installed).
+
+The reference's DDPG path targets gym MuJoCo-style continuous-control
+tasks (BASELINE.md tracked configs: Pendulum/HalfCheetah/Humanoid); this
+image ships neither gym nor MuJoCo, so the self-contained classic envs
+(envs/classic.py) carry CI — this adapter is the production path on
+machines that have gym installed: any Box/Discrete gym env becomes a
+framework Env with the standard surface (slot seeding, [-1,1] action
+normalisation for continuous spaces, truncation flagged for bootstrap
+semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.envs.base import (
+    ContinuousSpace, DiscreteSpace, Env,
+)
+
+# canonical game-name -> gym env id
+GYM_IDS = {
+    "pendulum": "Pendulum-v1",
+    "halfcheetah": "HalfCheetah-v4",
+    "humanoid": "Humanoid-v4",
+    "hopper": "Hopper-v4",
+    "walker2d": "Walker2d-v4",
+    "ant": "Ant-v4",
+    "cartpole": "CartPole-v1",
+}
+
+
+def _import_gym():
+    try:
+        import gymnasium as gym  # modern fork first
+
+        return gym, True
+    except ImportError:
+        pass
+    try:
+        import gym  # legacy
+
+        return gym, False
+    except ImportError as e:
+        raise ImportError(
+            "env_type 'gym' needs gymnasium or gym installed; this image "
+            "ships neither — use the self-contained envs (classic / "
+            "pong-sim / fake) instead") from e
+
+
+class GymEnv(Env):
+    def __init__(self, env_params, process_ind: int = 0):
+        super().__init__(env_params, process_ind)
+        gym, self._modern = _import_gym()
+        env_id = GYM_IDS.get(env_params.game, env_params.game)
+        self._env = gym.make(env_id)
+        if not self._modern and not hasattr(self._env, "seed"):
+            # legacy-named gym >= 0.26 already speaks the gymnasium API
+            # (reset(seed=...), 5-tuple step)
+            self._modern = True
+        self.norm_val = 1.0
+        space = self._env.action_space
+        if hasattr(space, "n"):
+            self._space = DiscreteSpace(int(space.n))
+        else:
+            low = np.asarray(space.low, dtype=np.float32)
+            high = np.asarray(space.high, dtype=np.float32)
+            # symmetric [-1,1] policy convention; per-dim rescale happens in
+            # _step (ContinuousSpace carries scalar low/high, gym may not be
+            # uniform across dims)
+            self._low, self._high = low, high
+            self._space = ContinuousSpace(dim=int(np.prod(space.shape)),
+                                          low=float(low.min()),
+                                          high=float(high.max()))
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        return tuple(self._env.observation_space.shape)
+
+    @property
+    def action_space(self):
+        return self._space
+
+    def _reset(self) -> np.ndarray:
+        if self._modern:
+            obs, _info = self._env.reset(seed=self.seed + self._episode_seed())
+        else:
+            self._env.seed(self.seed + self._episode_seed())
+            obs = self._env.reset()
+        return np.asarray(obs, dtype=np.float32)
+
+    def _episode_seed(self) -> int:
+        # fresh-but-deterministic episode seeds from the slot stream
+        return int(self.rng.integers(2 ** 20))
+
+    def _step(self, action) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        if isinstance(self._space, ContinuousSpace):
+            a = np.clip(np.asarray(action, np.float32).ravel(), -1.0, 1.0)
+            action = self._low + (a + 1.0) * 0.5 * (self._high - self._low)
+        else:
+            action = int(np.asarray(action))
+        if self._modern:
+            obs, r, terminated, truncated, info = self._env.step(action)
+            terminal = bool(terminated or truncated)
+            info = dict(info)
+            if truncated and not terminated:
+                info["truncated"] = True  # bootstrap through time limits
+        else:
+            obs, r, terminal, info = self._env.step(action)
+            info = dict(info)
+            if info.get("TimeLimit.truncated"):
+                info["truncated"] = True
+        return np.asarray(obs, dtype=np.float32), float(r), terminal, info

@@ -32,11 +32,18 @@ from pytorch_distributed_tpu.memory.feeder import QueueFeeder, QueueOwner
 # Component dicts (reference utils/factory.py:22-43)
 # ---------------------------------------------------------------------------
 
+def _gym_env(env_params, process_ind: int = 0):
+    from pytorch_distributed_tpu.envs.gym_adapter import GymEnv
+
+    return GymEnv(env_params, process_ind)
+
+
 EnvsDict: Dict[str, Callable] = {
     "atari": AtariEnv,            # reference factory.py:34 "atari"
     "fake": FakeChainEnv,         # test/smoke env (no reference equivalent)
     "classic": make_classic_env,  # cartpole / pendulum
     "pong-sim": PongSimEnv,       # ALE-free Pong clone
+    "gym": _gym_env,              # gym/gymnasium adapter (gated on install)
 }
 
 MemoriesDict: Dict[str, Optional[Callable]] = {
@@ -44,6 +51,7 @@ MemoriesDict: Dict[str, Optional[Callable]] = {
     "native": None,                    # C++ lock-free ring (native_ring.py)
     "prioritized": PrioritizedReplay,  # finishes the reference's PER TODO
     "device": None,                    # HBM-resident ring (device_replay.py)
+    "device-per": None,                # HBM prioritized ring (device_per.py)
     "none": None,                      # reference factory.py:38
 }
 
@@ -204,8 +212,10 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params):
     )
 
     ap = opt.agent_params
+    decay = ap.steps if ap.lr_decay else 0
     if opt.agent_type == "dqn":
-        tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay)
+        tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay,
+                            lr_decay_steps=decay)
         state = init_train_state(params, tx)
         step = build_dqn_train_step(
             model.apply, tx,
@@ -217,15 +227,16 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params):
     if opt.agent_type == "ddpg":
         actor_apply, critic_apply = ddpg_applies(model)
         if ap.ddpg_coupled_update:
-            tx = make_optimizer(ap.lr, ap.clip_grad)
+            tx = make_optimizer(ap.lr, ap.clip_grad, lr_decay_steps=decay)
             state = init_train_state(params, tx)
             step = build_ddpg_train_step_coupled(
                 actor_apply, critic_apply, tx,
                 target_model_update=ap.target_model_update,
             )
         else:
-            atx = make_optimizer(ap.lr, ap.clip_grad)
-            ctx_ = make_optimizer(ap.critic_lr, ap.clip_grad)
+            atx = make_optimizer(ap.lr, ap.clip_grad, lr_decay_steps=decay)
+            ctx_ = make_optimizer(ap.critic_lr, ap.clip_grad,
+                                  lr_decay_steps=decay)
             state = init_ddpg_train_state(params, atx, ctx_)
             step = build_ddpg_train_step(
                 actor_apply, critic_apply, atx, ctx_,
@@ -309,18 +320,26 @@ def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
         owner = QueueOwner(per)
         return MemoryHandles(actor_side=owner.make_feeder(),
                              learner_side=owner)
-    if opt.memory_type == "device":
+    if opt.memory_type in ("device", "device-per"):
         from pytorch_distributed_tpu.memory.device_replay import (
-            DeviceReplayIngest,
+            DevicePerIngest, DeviceReplayIngest,
         )
 
-        ingest = DeviceReplayIngest(
+        geom = dict(
             capacity=mp_.memory_size,
             state_shape=spec.state_shape,
             action_shape=spec.action_shape,
             state_dtype=state_dtype,
             action_dtype=spec.action_dtype,
         )
+        if opt.memory_type == "device-per":
+            ingest = DevicePerIngest(
+                priority_exponent=mp_.priority_exponent,
+                importance_weight=mp_.priority_weight,
+                importance_anneal_steps=opt.agent_params.steps,
+                **geom)
+        else:
+            ingest = DeviceReplayIngest(**geom)
         return MemoryHandles(actor_side=ingest.make_feeder(),
                              learner_side=ingest)
     raise ValueError(f"unknown memory_type: {opt.memory_type}")

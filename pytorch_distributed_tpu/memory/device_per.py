@@ -1,0 +1,191 @@
+"""Prioritized replay resident in HBM, fused into the learner step.
+
+The TPU-native completion of the reference's PER TODO beyond the host
+sum-tree (memory/prioritized.py): the host tree exists because CPUs need
+O(log N) sampling — a TPU doesn't.  Proportional sampling over a 50k-row
+ring is a cumulative sum + inverse-CDF search (``cumsum`` +
+``searchsorted``), microseconds of vectorized work that XLA fuses INTO the
+training program, along with the importance weights and the |TD| priority
+write-back.  One XLA program per learner step does: sample → forward →
+backward → Adam → target update → priority scatter — the learner hot loop
+never touches the host.
+
+Priorities are stored pre-exponentiated (p_i = (|td|+eps)^alpha) so the
+sampling pass needs no pow; new rows enter at the running max priority so
+everything is replayed at least once (Ape-X standard).  Importance weights
+are normalised by the max weight over valid rows (min-probability row),
+annealed by beta supplied per call.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.utils.experience import Batch, Transition
+
+
+class PerReplayState(NamedTuple):
+    state0: jax.Array
+    action: jax.Array
+    reward: jax.Array
+    gamma_n: jax.Array
+    state1: jax.Array
+    terminal1: jax.Array
+    priority: jax.Array      # (N,) f32, pre-exponentiated p^alpha; 0 = empty
+    max_priority: jax.Array  # () f32, running max of p^alpha
+    pos: jax.Array           # int32 write cursor
+    fill: jax.Array          # int32 valid rows
+
+
+def per_feed(state: PerReplayState, chunk: Transition,
+             capacity: int) -> PerReplayState:
+    """Ingest a chunk at the cursor; new rows take the running max
+    priority."""
+    n = chunk.reward.shape[0]
+    idx = (state.pos + jnp.arange(n, dtype=jnp.int32)) % capacity
+    return PerReplayState(
+        state0=state.state0.at[idx].set(chunk.state0),
+        action=state.action.at[idx].set(chunk.action),
+        reward=state.reward.at[idx].set(chunk.reward),
+        gamma_n=state.gamma_n.at[idx].set(chunk.gamma_n),
+        state1=state.state1.at[idx].set(chunk.state1),
+        terminal1=state.terminal1.at[idx].set(chunk.terminal1),
+        priority=state.priority.at[idx].set(state.max_priority),
+        max_priority=state.max_priority,
+        pos=(state.pos + n) % capacity,
+        fill=jnp.minimum(state.fill + n, capacity),
+    )
+
+
+def per_sample(state: PerReplayState, key: jax.Array, batch_size: int,
+               beta: jax.Array) -> Batch:
+    """Proportional sample + IS weights, all on device."""
+    p = state.priority  # empty rows hold 0 and can never be drawn
+    cdf = jnp.cumsum(p)
+    total = cdf[-1]
+    u = jax.random.uniform(key, (batch_size,)) * total
+    idx = jnp.clip(jnp.searchsorted(cdf, u, side="right"),
+                   0, state.priority.shape[0] - 1).astype(jnp.int32)
+    probs = p[idx] / jnp.maximum(total, 1e-12)
+    fill = jnp.maximum(state.fill.astype(jnp.float32), 1.0)
+    weights = (fill * jnp.maximum(probs, 1e-12)) ** (-beta)
+    # max weight = weight of the min-probability VALID row
+    min_p = jnp.min(jnp.where(p > 0, p, jnp.inf)) / jnp.maximum(total, 1e-12)
+    max_w = (fill * jnp.maximum(min_p, 1e-12)) ** (-beta)
+    weights = weights / jnp.maximum(max_w, 1e-12)
+    return Batch(
+        state0=state.state0[idx],
+        action=state.action[idx],
+        reward=state.reward[idx],
+        gamma_n=state.gamma_n[idx],
+        state1=state.state1[idx],
+        terminal1=state.terminal1[idx],
+        weight=weights.astype(jnp.float32),
+        index=idx,
+    )
+
+
+def per_update_priorities(state: PerReplayState, idx: jax.Array,
+                          td_abs: jax.Array, alpha: float,
+                          epsilon: float = 1e-6) -> PerReplayState:
+    """|TD| write-back (pre-exponentiated) + running-max maintenance."""
+    pr = (jnp.abs(td_abs) + epsilon) ** alpha
+    return state._replace(
+        priority=state.priority.at[idx].set(pr.astype(jnp.float32)),
+        max_priority=jnp.maximum(state.max_priority, jnp.max(pr)),
+    )
+
+
+class DevicePerReplay:
+    """Stateful wrapper owning the HBM PER ring (learner process only).
+
+    ``build_fused_step`` wraps a ``(TrainState, Batch) -> (TrainState,
+    metrics, td_abs)`` train step into ``(TrainState, PerReplayState, key,
+    beta) -> (TrainState, PerReplayState, metrics)`` — sampling and priority
+    write-back fused in.
+    """
+
+    def __init__(self, capacity: int, state_shape: Tuple[int, ...],
+                 action_shape: Tuple[int, ...] = (),
+                 state_dtype=np.uint8, action_dtype=np.int32,
+                 priority_exponent: float = 0.6,
+                 importance_weight: float = 0.4,
+                 importance_anneal_steps: int = 500000,
+                 mesh: Optional[jax.sharding.Mesh] = None):
+        self.capacity = capacity
+        self.state_dtype = np.dtype(state_dtype)
+        self.action_dtype = np.dtype(action_dtype)
+        self.alpha = priority_exponent
+        self.beta0 = importance_weight
+        self.beta_steps = importance_anneal_steps
+        self._row_sharding = None
+        self._scalar_sharding = None
+        if mesh is not None:
+            ndev = mesh.shape["dp"]
+            if capacity % ndev:
+                # same rounding contract as DeviceReplayIngest.attach
+                rounded = capacity + ndev - capacity % ndev
+                import warnings
+
+                warnings.warn(
+                    f"device PER capacity {capacity} rounded up to "
+                    f"{rounded} (multiple of mesh dp={ndev})", stacklevel=2)
+                capacity = self.capacity = rounded
+            P = jax.sharding.PartitionSpec
+            self._row_sharding = jax.sharding.NamedSharding(mesh, P("dp"))
+            self._scalar_sharding = jax.sharding.NamedSharding(mesh, P())
+
+        def alloc(shape, dtype, sharded=True):
+            arr = jnp.zeros(shape, dtype=dtype)
+            if self._row_sharding is not None:
+                arr = jax.device_put(
+                    arr,
+                    self._row_sharding if sharded else self._scalar_sharding)
+            return arr
+
+        N = capacity
+        self.state = PerReplayState(
+            state0=alloc((N, *state_shape), jnp.dtype(state_dtype)),
+            action=alloc((N, *action_shape), jnp.dtype(action_dtype)),
+            reward=alloc((N,), jnp.float32),
+            gamma_n=alloc((N,), jnp.float32),
+            state1=alloc((N, *state_shape), jnp.dtype(state_dtype)),
+            terminal1=alloc((N,), jnp.float32),
+            priority=alloc((N,), jnp.float32),
+            max_priority=alloc((), jnp.float32, sharded=False) + 1.0,
+            pos=alloc((), jnp.int32, sharded=False),
+            fill=alloc((), jnp.int32, sharded=False),
+        )
+        self._feed_fn = jax.jit(
+            functools.partial(per_feed, capacity=capacity),
+            donate_argnums=0)
+        self._sample_fn = jax.jit(per_sample, static_argnames="batch_size")
+
+    def feed_chunk(self, chunk: Transition) -> None:
+        self.state = self._feed_fn(self.state, chunk)
+
+    def beta(self, step: int) -> float:
+        frac = min(1.0, step / max(1, self.beta_steps))
+        return self.beta0 + (1.0 - self.beta0) * frac
+
+    def build_fused_step(self, train_step, batch_size: int,
+                         donate: bool = True):
+        alpha = self.alpha
+
+        def fused(ts, rs: PerReplayState, key, beta):
+            batch = per_sample(rs, key, batch_size, beta)
+            ts, metrics, td_abs = train_step(ts, batch)
+            rs = per_update_priorities(rs, batch.index, td_abs, alpha)
+            return ts, rs, metrics
+
+        return jax.jit(fused, donate_argnums=(0, 1) if donate else ())
+
+    def sample(self, batch_size: int, key: jax.Array,
+               beta: float = 1.0) -> Batch:
+        return self._sample_fn(self.state, key, batch_size=batch_size,
+                               beta=jnp.asarray(beta))

@@ -216,6 +216,11 @@ class DeviceReplayIngest:
         assert self.replay is not None, "attach() first"
         return min(self._fed_total, self.replay.capacity)
 
+    def close(self) -> None:
+        """See QueueOwner.close: reap the queue feeder thread."""
+        self._q.close()
+        self._q.join_thread()
+
     def drain(self, max_chunks: int = 1024,
               max_rows: int = 32768) -> int:
         """Move queued transitions into HBM; bounded by ``max_rows`` per
@@ -245,3 +250,30 @@ class DeviceReplayIngest:
             fed += C
         self._fed_total += fed
         return fed
+
+
+class DevicePerIngest(DeviceReplayIngest):
+    """Queue front end for the HBM prioritized ring (device_per.py): same
+    chunked ingestion; new rows enter at max priority, so the actor-side
+    initial-priority plumbing is intentionally bypassed on this path —
+    priorities live and update entirely on device."""
+
+    def __init__(self, *args, priority_exponent: float = 0.6,
+                 importance_weight: float = 0.4,
+                 importance_anneal_steps: int = 500000, **kw):
+        super().__init__(*args, **kw)
+        self.priority_exponent = priority_exponent
+        self.importance_weight = importance_weight
+        self.importance_anneal_steps = importance_anneal_steps
+
+    def attach(self, mesh: Optional[jax.sharding.Mesh] = None):
+        from pytorch_distributed_tpu.memory.device_per import DevicePerReplay
+
+        self.replay = DevicePerReplay(
+            self.capacity, self.state_shape, self.action_shape,
+            self.state_dtype, self.action_dtype,
+            priority_exponent=self.priority_exponent,
+            importance_weight=self.importance_weight,
+            importance_anneal_steps=self.importance_anneal_steps,
+            mesh=mesh)
+        return self.replay

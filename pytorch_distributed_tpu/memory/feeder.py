@@ -94,3 +94,10 @@ class QueueOwner:
     def feed(self, transition: Transition,
              priority: Optional[float] = None) -> None:
         self.memory.feed(transition, priority)
+
+    def close(self) -> None:
+        """Shut the queue's feeder thread down cleanly — a daemon
+        QueueFeederThread left alive at interpreter exit aborts the process
+        from C++ teardown."""
+        self._q.close()
+        self._q.join_thread()

@@ -64,15 +64,20 @@ def init_train_state(params: PyTree,
 
 
 def make_optimizer(lr: float, clip_grad: float = float("inf"),
-                   weight_decay: float = 0.0) -> optax.GradientTransformation:
+                   weight_decay: float = 0.0,
+                   lr_decay_steps: int = 0) -> optax.GradientTransformation:
     """Adam with optional by-value grad clipping, matching the reference's
-    Adam + clip_grad_value_ pairing (reference dqn_learner.py:37-39,80-82)."""
+    Adam + clip_grad_value_ pairing (reference dqn_learner.py:37-39,80-82).
+    ``lr_decay_steps > 0`` linearly anneals the lr to zero over that many
+    learner steps (the reference's ``lr_decay`` flag, utils/options.py)."""
     chain = []
     if clip_grad != float("inf"):
         chain.append(optax.clip(clip_grad))  # by-value, like clip_grad_value_
     if weight_decay > 0.0:
         chain.append(optax.add_decayed_weights(weight_decay))
-    chain.append(optax.adam(lr))
+    schedule = (optax.linear_schedule(lr, 0.0, lr_decay_steps)
+                if lr_decay_steps > 0 else lr)
+    chain.append(optax.adam(schedule))
     return optax.chain(*chain)
 
 

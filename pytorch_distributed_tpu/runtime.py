@@ -98,12 +98,9 @@ class Topology:
         assert backend in ("process", "thread")
         opt = self.opt
         if backend == "process":
+            self._proc_meta = []
             for role, ind, args in self._worker_specs():
-                p = _CTX.Process(
-                    target=_child_main, args=(role, opt.agent_type, args),
-                    name=f"{role}-{ind}", daemon=True)
-                p.start()
-                self._workers.append(p)
+                self._spawn(role, ind, args)
             monitor = threading.Thread(target=self._monitor, daemon=True)
             monitor.start()
         else:
@@ -122,18 +119,51 @@ class Topology:
             # learner done (or dead): release every spinning loop
             self.clock.stop.set()
             self._join_all()
+            if hasattr(self.handles.learner_side, "close"):
+                self.handles.learner_side.close()
 
-    def _monitor(self, poll: float = 0.5) -> None:
-        """Trip the stop event when any child dies abnormally — the failure
-        detection the reference lacks."""
+    def _spawn(self, role: str, ind: int, args: tuple) -> None:
+        p = _CTX.Process(
+            target=_child_main, args=(role, self.opt.agent_type, args),
+            name=f"{role}-{ind}", daemon=True)
+        p.start()
+        self._workers.append(p)
+        self._proc_meta.append((p, role, ind, args))
+
+    def _monitor(self, poll: float = 0.5, max_restarts: int = 3) -> None:
+        """Failure detection + elastic recovery — both absent in the
+        reference, where a dead actor silently reduces throughput and a
+        dead learner hangs every loop (SURVEY.md §5).  A crashed ACTOR is
+        restarted in place (Ape-X tolerates actor churn; its replay
+        contribution just pauses), up to ``max_restarts`` per slot; any
+        other abnormal child death — or an actor out of restart budget —
+        trips the stop event so the run fails fast instead of degrading
+        silently."""
+        restarts: dict = {}
         while not self.clock.stop.is_set():
-            for p in self._workers:
-                if isinstance(p, _CTX.Process) and p.exitcode not in (None, 0):
+            for i, (p, role, ind, args) in enumerate(list(self._proc_meta)):
+                if p.exitcode in (None, 0):
+                    continue
+                if role == "actor" and restarts.get(ind, 0) < max_restarts:
+                    restarts[ind] = restarts.get(ind, 0) + 1
+                    print(f"[runtime] actor-{ind} died "
+                          f"(exit {p.exitcode}); restart "
+                          f"{restarts[ind]}/{max_restarts}")
+                    self._workers.remove(p)
+                    self._proc_meta.remove((p, role, ind, args))
+                    self._spawn(role, ind, args)
+                else:
+                    print(f"[runtime] {role}-{ind} died "
+                          f"(exit {p.exitcode}); stopping run")
                     self.clock.stop.set()
                     return
             time.sleep(poll)
 
-    def _join_all(self, timeout: float = 30.0) -> None:
+    def _join_all(self, timeout: float = 240.0) -> None:
+        # generous: the evaluator's final eval (jit + greedy episodes) can
+        # take minutes on a saturated host, and a thread-backend worker
+        # abandoned at interpreter exit aborts the process from C++
+        # teardown — waiting is the safe side
         deadline = time.monotonic() + timeout
         for w in self._workers:
             w.join(max(0.1, deadline - time.monotonic()))

@@ -1,0 +1,69 @@
+"""Tracing / profiling subsystem.
+
+The reference has none — only stdout banners and TensorBoard scalars
+(SURVEY.md §5 "tracing: none").  Two first-class tools here:
+
+- ``StepTimer``: cheap per-role wall-time accounting.  Workers wrap their
+  hot-loop phases (act / env.step / feed / learn / drain / publish) and the
+  accumulated per-phase seconds flow into the metrics stream on the normal
+  logger cadence, so "where does the step time go" is a dashboard read, not
+  a guess.
+- ``trace``: a context manager around ``jax.profiler.trace`` that captures
+  a real XLA trace (TensorBoard-viewable) for a bounded window, gated so it
+  can be left in production code and switched on with an env var
+  (``TPU_APEX_PROFILE=dir``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Dict, Iterator, Optional
+
+
+class StepTimer:
+    """Accumulates wall seconds per named phase; drain() returns and resets
+    {phase: (seconds, calls)} as flat metrics."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        self._acc: Dict[str, float] = {}
+        self._n: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+            self._n[name] = self._n.get(name, 0) + 1
+
+    def drain(self) -> Dict[str, float]:
+        out = {}
+        for name, secs in self._acc.items():
+            n = self._n[name]
+            out[f"{self.prefix}/time_{name}_ms"] = secs / max(n, 1) * 1e3
+        self._acc.clear()
+        self._n.clear()
+        return out
+
+
+@contextlib.contextmanager
+def trace(label: str, log_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture an XLA profiler trace for the enclosed block when enabled.
+
+    Enabled by passing ``log_dir`` or by setting ``TPU_APEX_PROFILE`` to a
+    directory; otherwise a no-op.  View with TensorBoard's profile plugin.
+    """
+    target = log_dir or os.environ.get("TPU_APEX_PROFILE")
+    if not target:
+        yield
+        return
+    import jax
+
+    os.makedirs(target, exist_ok=True)
+    with jax.profiler.trace(os.path.join(target, label)):
+        yield

@@ -141,3 +141,51 @@ def test_vector_env_actor_topology(tmp_path):
     assert topo.clock.actor_step.value >= 4
     recs = read_scalars(opt.log_dir)
     assert any(r["tag"] == "actor/avg_reward" for r in recs)
+
+
+def test_actor_crash_restarts_elastically(tmp_path, monkeypatch):
+    """Failure supervision: a dying actor child is respawned in place and
+    the run completes (process backend)."""
+    import pytorch_distributed_tpu.runtime as rt
+
+    opt = _opts(tmp_path, config=1, steps=150, num_actors=1)
+    topo = rt.Topology(opt)
+
+    killed = {"done": False}
+    orig_child = rt._child_main
+
+    # patching rt._child_main affects only the parent's spawn target ref;
+    # spawn pickles the function by qualified name, so instead simulate the
+    # crash by terminating the live actor child once it is up
+    import threading, time as _time
+
+    def killer():
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline and not killed["done"]:
+            for p, role, ind, args in list(getattr(topo, "_proc_meta", [])):
+                if role == "actor" and p.is_alive():
+                    p.terminate()  # exitcode -SIGTERM != 0 -> restart path
+                    killed["done"] = True
+                    return
+            _time.sleep(0.5)
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    topo.run(backend="process")
+    assert killed["done"], "test never saw a live actor to kill"
+    assert topo.clock.learner_step.value >= 150
+    # the monitor respawned rather than stopping the run
+    assert len(topo._proc_meta) >= 3
+
+
+def test_device_per_topology_runs(tmp_path):
+    opt = _opts(tmp_path, config=1, memory_type="device-per", steps=200)
+    topo = runtime.train(opt, backend="thread")
+    assert topo.clock.learner_step.value >= 200
+    replay = topo.handles.learner_side.replay
+    import numpy as np
+    pr = np.asarray(replay.state.priority)
+    # priorities were written back on device: sampled rows no longer all
+    # carry the uniform insert priority
+    valid = pr[pr > 0]
+    assert len(np.unique(np.round(valid, 6))) > 1
