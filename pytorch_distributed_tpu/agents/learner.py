@@ -134,6 +134,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
 
     rng = np_rng(opt.seed, "learner", process_ind)
     lstep = int(jax.device_get(state.step))
+    lstep0 = lstep  # checkpoint-resumed steps; pacing baselines on THIS run
     clock.set_learner_step(lstep)
 
     # ---- gate until the replay warms up (reference dqn_learner.py:51) ----
@@ -154,6 +155,22 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     timing_writer = MetricsWriter(opt.log_dir, enable_tensorboard=False)
 
     while lstep < ap.steps and not clock.stop.is_set():
+        if ap.max_replay_ratio > 0:
+            # pacing gate: don't draw more than max_replay_ratio samples
+            # per collected transition (config.py AgentParams docstring).
+            # Baselined on THIS run's steps (lstep - lstep0): a resumed
+            # checkpoint's cumulative count against a fresh actor clock
+            # would stall the learner for hours.  Queue-backed memories
+            # keep draining while throttled — a full ingest queue blocks
+            # actors before they can advance the clock (deadlock).
+            while (not clock.stop.is_set()
+                   and (lstep - lstep0 + 1) * ap.batch_size
+                   > ap.max_replay_ratio * max(clock.actor_step.value, 1)):
+                if hasattr(memory, "drain"):
+                    memory.drain()
+                time.sleep(0.002)
+            if clock.stop.is_set():
+                break
         if on_device:
             with timer.phase("drain"):
                 memory.drain()

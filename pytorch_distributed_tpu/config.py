@@ -156,6 +156,14 @@ class AgentParams:
     # --- off-policy core (reference :134-137 / :163-166) ---
     learn_start: int = 5000            # ddpg: 250
     batch_size: int = 128              # ddpg: 64
+    # Cap on samples-drawn-per-transition-collected (replay ratio): the
+    # learner throttles when learner_step * batch_size exceeds
+    # max_replay_ratio * global actor steps.  0 disables.  No reference
+    # equivalent — there the GPU learner can't outrun 8 CPU actors; a TPU
+    # learner can outrun any actor fleet, collapsing replay diversity, so
+    # the pacing knob is first-class here (standard in Ape-X-family
+    # systems).
+    max_replay_ratio: float = 0.0
     target_model_update: float = 250   # >=1: hard every N steps; <1: soft tau
     nstep: int = 5
     # --- dqn specifics (reference :138-141) ---

@@ -1,0 +1,212 @@
+"""Multi-host fleet runner: one learner host + N actor hosts over DCN.
+
+The reference runs everything on one machine through
+``torch.multiprocessing`` (reference main.py:13,58-106); its topology ends
+at the box.  A TPU deployment splits naturally: the host attached to the
+mesh runs the learner (plus evaluator/logger and optionally some local
+actors), and any number of CPU-only hosts run actor fan-out, connected by
+the DCN wire protocol (parallel/dcn.py).  Fleet-wide semantics match the
+single-host run:
+
+- ``opt.num_actors`` is the TOTAL actor count across hosts — the Ape-X
+  exploration schedule (reference dqn_actor.py:33-36) spans the fleet, each
+  actor taking its global ``process_ind`` slot;
+- the global learner clock terminates every loop on every host (reference
+  dqn_actor.py:62), carried by gateway replies;
+- stats aggregate into the learner host's accumulators, so the logger and
+  TensorBoard streams look identical to a single-host run.
+
+Roles (one per invocation, mirroring how NCCL/MPI launchers assign ranks):
+
+    python -m pytorch_distributed_tpu.fleet --role learner \
+        --config 4 --port 5555 --local-actors 2
+    python -m pytorch_distributed_tpu.fleet --role actors \
+        --config 4 --coordinator learnerhost:5555 \
+        --actor-base 2 --actor-count 6
+
+For TPU pods where multiple hosts each own chips (v4-32+), set
+``parallel_params.multihost`` so the learner program itself spans hosts via
+``jax.distributed`` (parallel/mesh.py init_multihost); the fleet layer here
+is about scaling the *actor* side and is orthogonal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import multiprocessing as mp
+import os
+import time
+from typing import List, Optional
+
+from pytorch_distributed_tpu.config import Options, build_options
+from pytorch_distributed_tpu.runtime import Topology
+
+_CTX = mp.get_context("spawn")
+
+
+class FleetTopology(Topology):
+    """Learner-host topology: the usual local workers (minus remote actor
+    slots) plus a DcnGateway bridging remote hosts into the shared plane."""
+
+    def __init__(self, opt: Options, local_actors: int = 0, port: int = 0,
+                 spec=None):
+        super().__init__(opt, spec=spec)
+        self.local_actors = min(local_actors, opt.num_actors)
+        from pytorch_distributed_tpu.parallel.dcn import (
+            DcnGateway, feed_queue_of,
+        )
+
+        self.gateway = DcnGateway(
+            self.param_store, self.clock, self.actor_stats,
+            put_chunk=feed_queue_of(self.handles), port=port,
+            local_actors=self.local_actors)
+        self.port = self.gateway.port
+
+    def _worker_specs(self):
+        # local actor slots are [0, local_actors); remote hosts take the
+        # higher process_inds (flatter Ape-X epsilons, the exploratory end)
+        specs = [s for s in super()._worker_specs()
+                 if s[0] != "actor" or s[1] < self.local_actors]
+        return specs
+
+    def _pre_close(self) -> None:
+        # stop accepting/serving before the learner-side queue closes:
+        # an in-flight EXP put on a closed queue would kill a serve thread
+        self.gateway.close()
+
+    def run(self, backend: str = "process") -> None:
+        try:
+            super().run(backend=backend)
+        finally:
+            self.gateway.close()  # idempotent; covers pre-run failures
+
+
+def run_fleet_learner(opt: Options, local_actors: int = 0, port: int = 5555,
+                      backend: str = "process") -> FleetTopology:
+    topo = FleetTopology(opt, local_actors=local_actors, port=port)
+    print(f"[fleet] learner host up: gateway on port {topo.port}, "
+          f"{topo.local_actors}/{opt.num_actors} actors local")
+    topo.run(backend=backend)
+    return topo
+
+
+# ---------------------------------------------------------------------------
+# actor host
+# ---------------------------------------------------------------------------
+
+def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
+                       ) -> None:
+    """One remote rollout worker: DCN adapters in place of the shared-memory
+    plane, then the standard actor loop (agents/actor.py) unmodified."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_distributed_tpu.factory import get_worker, probe_env
+    from pytorch_distributed_tpu.parallel.dcn import (
+        DcnClient, RemoteClock, RemoteMemory, RemoteParamStore, RemoteStats,
+    )
+
+    host, port = coordinator.rsplit(":", 1)
+    client = DcnClient((host, int(port)), process_ind=process_ind)
+    memory = RemoteMemory(client)
+    clock = RemoteClock(client)
+    try:
+        spec = probe_env(opt)
+        get_worker("actor", opt.agent_type)(
+            opt, spec, process_ind, memory, RemoteParamStore(client), clock,
+            RemoteStats(client))
+    finally:
+        try:
+            memory.flush()
+            clock.flush()
+        except (ConnectionError, OSError):
+            pass
+        client.close()
+
+
+def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
+                     actor_count: int, backend: str = "process") -> None:
+    """Run ``actor_count`` rollout workers holding global process_inds
+    ``[actor_base, actor_base + actor_count)``."""
+    assert actor_base + actor_count <= opt.num_actors, (
+        f"actor slots [{actor_base}, {actor_base + actor_count}) exceed "
+        f"fleet num_actors={opt.num_actors}")
+    workers: List = []
+    for i in range(actor_count):
+        ind = actor_base + i
+        if backend == "process":
+            w = _CTX.Process(target=_remote_actor_main,
+                             args=(opt, coordinator, ind),
+                             name=f"fleet-actor-{ind}", daemon=True)
+        else:
+            import threading
+
+            w = threading.Thread(target=_remote_actor_main,
+                                 args=(opt, coordinator, ind),
+                                 name=f"fleet-actor-{ind}", daemon=True)
+        w.start()
+        workers.append(w)
+    print(f"[fleet] actor host up: {actor_count} actors "
+          f"(slots {actor_base}..{actor_base + actor_count - 1}) -> "
+          f"{coordinator}")
+    for w in workers:
+        w.join()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="pytorch_distributed_tpu.fleet",
+        description="multi-host Ape-X fleet launcher")
+    ap.add_argument("--role", choices=("learner", "actors"), required=True)
+    ap.add_argument("--config", type=int, default=1)
+    ap.add_argument("--num-actors", type=int, default=None,
+                    help="TOTAL fleet actor count (defaults to config)")
+    ap.add_argument("--port", type=int, default=5555)
+    ap.add_argument("--local-actors", type=int, default=0,
+                    help="[learner] actors co-located on the learner host")
+    ap.add_argument("--coordinator", type=str, default=None,
+                    help="[actors] learner host as host:port")
+    ap.add_argument("--actor-base", type=int, default=0,
+                    help="[actors] first global actor slot on this host")
+    ap.add_argument("--actor-count", type=int, default=8,
+                    help="[actors] actors to run on this host")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--set", action="append", default=[], metavar="K=V",
+                    help="Options override, e.g. --set steps=2000 "
+                         "--set batch_size=32 (repeatable; int/float/str "
+                         "auto-typed). Must match on every host.")
+    args = ap.parse_args(argv)
+
+    overrides = {}
+    if args.num_actors is not None:
+        overrides["num_actors"] = args.num_actors
+    if args.seed is not None:
+        overrides["seed"] = args.seed
+    for kv in args.set:
+        k, _, v = kv.partition("=")
+        for cast in (int, float):
+            try:
+                v = cast(v)
+                break
+            except ValueError:
+                continue
+        overrides[k] = v
+    opt = build_options(args.config, **overrides)
+
+    if args.role == "learner":
+        run_fleet_learner(opt, local_actors=args.local_actors,
+                          port=args.port)
+    else:
+        assert args.coordinator, "--coordinator host:port required"
+        run_fleet_actors(opt, args.coordinator, args.actor_base,
+                         args.actor_count)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,478 @@
+"""DCN transport: cross-host experience ingestion + parameter publication.
+
+No reference equivalent — the reference's entire communication backend is
+single-machine ``torch.multiprocessing`` shared memory (reference main.py:13,
+core/memories/shared_memory.py:30-37; SURVEY.md §2 "distributed communication
+backend").  On a TPU pod the learner host owns the mesh and remote actor
+hosts cannot share pages with it, so the three shared-state mechanisms the
+reference relies on become one explicit wire protocol over DCN
+(host-to-host Ethernet/ICI-external network):
+
+- **experience in** — actors stream fixed-schema transition chunks to the
+  learner host's ``DcnGateway``, which forwards them into the same
+  single-owner spawn queue the local feeders use (memory/feeder.py,
+  memory/device_replay.py): the learner drains local and remote experience
+  through one path.
+- **weights out** — the gateway answers versioned parameter requests from
+  the learner's ``ParamStore`` snapshot; remote actors poll on their
+  ``actor_sync_freq`` cadence exactly like local ones (reference
+  dqn_actor.py:176-178), with staleness bounded by cadence + one RTT.
+- **clocks/stats** — the global learner step rides back on every reply
+  (actors need it only for termination, reference dqn_actor.py:62), and
+  actor-step/stat increments are batched client-side so the hot loop never
+  blocks on the network.
+
+Wire format: 1-byte frame type + 8-byte big-endian payload length, then the
+payload — JSON for control frames, ``np.savez`` for experience chunks, raw
+fp32 for parameter snapshots.  No pickle on the wire: frames are
+schema-checked, so a gateway never executes peer-controlled code.
+
+Client-side adapters (``RemoteMemory``, ``RemoteParamStore``,
+``RemoteClock``, ``RemoteStats``) present the exact surfaces the actor
+harness binds to (agents/actor.py), so ``run_dqn_actor``/``run_ddpg_actor``
+run unmodified on a remote host.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+from pytorch_distributed_tpu.memory.feeder import QueueFeeder
+from pytorch_distributed_tpu.utils.experience import Transition
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+_HDR = struct.Struct("!BQ")
+
+T_HELLO = 1    # JSON {role, process_ind}            -> T_CLOCK
+T_EXP = 2      # savez transition chunk              -> T_CLOCK
+T_GETP = 3     # !Q min_version                      -> T_PARAMS
+T_PARAMS = 4   # !Q version + raw fp32 (empty = no newer snapshot)
+T_CLOCK = 5    # JSON {learner_step, stop}
+T_TICK = 6     # JSON {actor_steps, stats?}          -> T_CLOCK
+T_BYE = 7      # empty                               -> (close)
+
+_MAX_FRAME = 1 << 31  # 2 GiB — far above any chunk; rejects garbage lengths
+
+
+def _send_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(ftype, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("peer closed")
+        buf.extend(part)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    ftype, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if length > _MAX_FRAME:
+        raise ConnectionError(f"oversized frame: {length}")
+    return ftype, _recv_exact(sock, length) if length else b""
+
+
+# ---------------------------------------------------------------------------
+# experience chunk encoding: columnar, no pickle
+# ---------------------------------------------------------------------------
+
+_FIELDS = ("state0", "action", "reward", "gamma_n", "state1", "terminal1")
+
+
+def encode_chunk(items: List[Tuple[Transition, Optional[float]]]) -> bytes:
+    """Stack a chunk of (transition, priority) into one savez payload.
+    ``priority`` None (uniform / new-sample-max semantics) encodes as NaN."""
+    cols = {f: np.stack([np.asarray(getattr(t, f)) for t, _ in items])
+            for f in _FIELDS}
+    cols["priority"] = np.array(
+        [np.nan if p is None else float(p) for _, p in items],
+        dtype=np.float32)
+    out = io.BytesIO()
+    np.savez(out, **cols)
+    return out.getvalue()
+
+
+def decode_chunk(payload: bytes
+                 ) -> List[Tuple[Transition, Optional[float]]]:
+    with np.load(io.BytesIO(payload)) as z:
+        cols = {k: z[k] for k in z.files}
+    n = len(cols["priority"])
+    items: List[Tuple[Transition, Optional[float]]] = []
+    for i in range(n):
+        t = Transition(*(cols[f][i] for f in _FIELDS))
+        p = cols["priority"][i]
+        items.append((t, None if np.isnan(p) else float(p)))
+    return items
+
+
+# ---------------------------------------------------------------------------
+# learner-host gateway
+# ---------------------------------------------------------------------------
+
+class DcnGateway:
+    """Accepts remote-actor connections on the learner host.
+
+    ``put_chunk`` receives decoded ``[(Transition, priority), ...]`` lists —
+    wire it to the single-owner memory's spawn queue (``feed_queue_of``) so
+    remote experience merges with local feeders on the learner's drain path.
+    """
+
+    def __init__(self, param_store, clock, actor_stats,
+                 put_chunk: Callable[[list], None],
+                 host: str = "0.0.0.0", port: int = 0,
+                 local_actors: int = 0):
+        self.param_store = param_store
+        self.clock = clock
+        self.actor_stats = actor_stats
+        self.put_chunk = put_chunk
+        self.local_actors = local_actors
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(0.25)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._active_slots: set = set()
+        self._slots_lock = threading.Lock()
+        self.connections = 0
+        self.chunks_in = 0
+        # all state above must exist before the first connection lands
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="dcn-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- server loops -------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.connections += 1
+            t = threading.Thread(target=self._serve, args=(conn, addr),
+                                 name=f"dcn-conn-{addr}", daemon=True)
+            t.start()
+            # prune threads of departed peers — actor churn is expected
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _clock_payload(self) -> bytes:
+        return json.dumps({
+            "learner_step": int(self.clock.learner_step.value),
+            "stop": bool(self.clock.stop.is_set()),
+        }).encode()
+
+    def _claim_slot(self, ind: Optional[int]) -> Optional[str]:
+        """Register a remote actor's global slot; returns an error string on
+        a conflict (slot owned by the learner host's local actors or already
+        held by a live connection — duplicate slots silently skew the
+        fleet-wide Ape-X epsilon schedule)."""
+        if ind is None:
+            return None
+        with self._slots_lock:
+            if ind < self.local_actors:
+                return (f"actor slot {ind} is local to the learner host "
+                        f"(local_actors={self.local_actors})")
+            if ind in self._active_slots:
+                return f"actor slot {ind} already connected"
+            self._active_slots.add(ind)
+        return None
+
+    def _serve(self, conn: socket.socket, addr) -> None:
+        slot: Optional[int] = None
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    ftype, payload = _recv_frame(conn)
+                    if ftype == T_BYE:
+                        return
+                    elif ftype == T_EXP:
+                        try:
+                            self.put_chunk(decode_chunk(payload))
+                        except ValueError:
+                            # memory queue already closed: the run is over;
+                            # answer with the stop-carrying clock instead of
+                            # dying with a traceback
+                            pass
+                        self.chunks_in += 1
+                        _send_frame(conn, T_CLOCK, self._clock_payload())
+                    elif ftype == T_GETP:
+                        (min_version,) = struct.unpack("!Q", payload)
+                        got = self.param_store.fetch(min_version)
+                        if got is None:
+                            _send_frame(conn, T_PARAMS,
+                                        struct.pack("!Q", 0))
+                        else:
+                            flat, version = got
+                            _send_frame(
+                                conn, T_PARAMS,
+                                struct.pack("!Q", version)
+                                + np.ascontiguousarray(
+                                    flat, dtype=np.float32).tobytes())
+                    elif ftype == T_TICK:
+                        msg = json.loads(payload.decode())
+                        steps = int(msg.get("actor_steps", 0))
+                        if steps:
+                            self.clock.add_actor_steps(steps)
+                        kv = msg.get("stats")
+                        if kv:
+                            self.actor_stats.add(
+                                **{k: float(v) for k, v in kv.items()})
+                        _send_frame(conn, T_CLOCK, self._clock_payload())
+                    elif ftype == T_HELLO:
+                        msg = json.loads(payload.decode())
+                        ind = msg.get("process_ind")
+                        err = self._claim_slot(ind)
+                        if err is not None:
+                            reply = json.loads(self._clock_payload())
+                            reply["error"] = err
+                            _send_frame(conn, T_CLOCK,
+                                        json.dumps(reply).encode())
+                            return
+                        slot = ind
+                        _send_frame(conn, T_CLOCK, self._clock_payload())
+                    else:
+                        raise ConnectionError(f"bad frame type {ftype}")
+        except (ConnectionError, OSError):
+            return  # peer went away; Ape-X tolerates actor churn
+        finally:
+            if slot is not None:
+                with self._slots_lock:
+                    self._active_slots.discard(slot)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(1.0)
+
+
+def feed_queue_of(memory_handles) -> Callable[[list], None]:
+    """The gateway->memory bridge: single-owner learner-side memories
+    (QueueOwner, DeviceReplayIngest) drain a spawn queue of
+    ``[(Transition, priority)]`` chunks; remote chunks enter that same
+    queue.  Multi-writer shared rings (SharedReplay/NativeRingReplay) take
+    direct feeds — their ``feed`` is already cross-process safe."""
+    learner_side = memory_handles.learner_side
+    q = getattr(learner_side, "_q", None)
+    if q is not None:
+        return q.put
+
+    def _direct(items: list) -> None:
+        for t, p in items:
+            learner_side.feed(t, p)
+    return _direct
+
+
+# ---------------------------------------------------------------------------
+# actor-host client + adapters
+# ---------------------------------------------------------------------------
+
+class DcnClient:
+    """One connection to the gateway, shared by the adapters of one actor
+    process.  All requests are synchronous request/reply under a lock; every
+    reply refreshes the cached learner clock."""
+
+    def __init__(self, address: Tuple[str, int], process_ind: int = 0,
+                 connect_timeout: float = 60.0, retries: int = 20):
+        self.address = address
+        self.process_ind = process_ind
+        self._lock = threading.RLock()
+        self.learner_step = 0
+        self.stop = threading.Event()
+        deadline = time.monotonic() + connect_timeout
+        delay = 0.1
+        while True:
+            try:
+                self._sock = socket.create_connection(address, timeout=30.0)
+                break
+            except OSError:
+                if time.monotonic() > deadline or retries <= 0:
+                    raise
+                retries -= 1
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        # blocking from here on: a slow gateway (learner jit compile,
+        # ingest-queue backpressure) must stall the actor — the correct
+        # flow control — not masquerade as a dead peer; death is detected
+        # by TCP reset/close, same as the local runtime monitor
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._request(T_HELLO, json.dumps(
+            {"role": "actor", "process_ind": process_ind}).encode())
+
+    def _request(self, ftype: int, payload: bytes) -> Tuple[int, bytes]:
+        with self._lock:
+            try:
+                _send_frame(self._sock, ftype, payload)
+                rtype, rpayload = _recv_frame(self._sock)
+            except (ConnectionError, OSError):
+                # learner host gone: treat as global stop, as the runtime
+                # monitor would locally (runtime.py _monitor)
+                self.stop.set()
+                raise
+        if rtype == T_CLOCK:
+            msg = json.loads(rpayload.decode())
+            self.learner_step = int(msg["learner_step"])
+            if msg.get("stop"):
+                self.stop.set()
+            if "error" in msg:  # e.g. actor-slot conflict at HELLO
+                self.stop.set()
+                raise RuntimeError(f"gateway refused: {msg['error']}")
+        return rtype, rpayload
+
+    def send_chunk(self, items: list) -> None:
+        self._request(T_EXP, encode_chunk(items))
+
+    def get_params(self, min_version: int
+                   ) -> Optional[Tuple[np.ndarray, int]]:
+        _, payload = self._request(T_GETP, struct.pack("!Q", min_version))
+        (version,) = struct.unpack("!Q", payload[:8])
+        if version == 0:
+            return None
+        return np.frombuffer(payload[8:], dtype=np.float32).copy(), version
+
+    def tick(self, actor_steps: int = 0,
+             stats: Optional[Dict[str, float]] = None) -> int:
+        msg: Dict[str, Any] = {"actor_steps": actor_steps}
+        if stats:
+            msg["stats"] = stats
+        self._request(T_TICK, json.dumps(msg).encode())
+        return self.learner_step
+
+    def close(self) -> None:
+        try:
+            with self._lock:
+                _send_frame(self._sock, T_BYE, b"")
+                self._sock.close()
+        except OSError:
+            pass
+
+
+class _ChunkSink:
+    """Duck-types the queue end QueueFeeder writes to: ``put(items)``
+    becomes one EXP frame."""
+
+    def __init__(self, client: DcnClient):
+        self._client = client
+
+    def put(self, items: list) -> None:
+        self._client.send_chunk(items)
+
+
+class RemoteMemory(QueueFeeder):
+    """Actor-side feed endpoint over DCN: QueueFeeder's chunk buffering,
+    with the spawn queue replaced by the wire."""
+
+    def __init__(self, client: DcnClient, chunk: int = 64):
+        super().__init__(_ChunkSink(client), chunk=chunk)
+
+
+class RemoteParamStore:
+    """Read surface of agents/param_store.py ParamStore over DCN."""
+
+    def __init__(self, client: DcnClient):
+        self._client = client
+
+    def fetch(self, min_version: int = 0
+              ) -> Optional[Tuple[np.ndarray, int]]:
+        return self._client.get_params(min_version)
+
+    # ParamStore.wait is written purely against self.fetch, so the poll
+    # loop (startup blocking, stop-event handling, timeout) is shared
+    # verbatim rather than re-implemented.
+    wait = ParamStore.wait
+
+
+class _StepShim:
+    """Duck-types ``mp.Value`` for the clock's learner_step reads."""
+
+    def __init__(self, client: DcnClient):
+        self._client = client
+
+    @property
+    def value(self) -> int:
+        return self._client.learner_step
+
+
+class RemoteClock:
+    """GlobalClock surface for remote actors.  ``add_actor_steps``
+    accumulates locally and flushes to the gateway on a count/time cadence —
+    a per-env-step RPC would put one RTT in the rollout hot loop; the
+    learner-step view is refreshed by every flush (and by every experience
+    chunk ack), so ``done()`` staleness is bounded by the cadence, matching
+    the reference's tolerance for stale clock reads (reference
+    dqn_actor.py:62 reads an unlocked mp.Value)."""
+
+    def __init__(self, client: DcnClient, flush_every: int = 256,
+                 max_age: float = 2.0):
+        self._client = client
+        self._flush_every = flush_every
+        self._max_age = max_age
+        self._pending = 0
+        self._last_flush = time.monotonic()
+        self.learner_step = _StepShim(client)
+
+    @property
+    def stop(self) -> threading.Event:
+        return self._client.stop
+
+    def add_actor_steps(self, n: int = 1) -> int:
+        self._pending += n
+        now = time.monotonic()
+        if (self._pending >= self._flush_every
+                or now - self._last_flush > self._max_age):
+            self.flush()
+        return self._client.learner_step
+
+    def flush(self) -> None:
+        pending, self._pending = self._pending, 0
+        self._last_flush = time.monotonic()
+        try:
+            self._client.tick(actor_steps=pending)
+        except (ConnectionError, OSError):
+            pass  # stop is set by the client; done() will see it
+
+    def done(self, steps: int) -> bool:
+        if self._client.stop.is_set():
+            return True
+        if time.monotonic() - self._last_flush > self._max_age:
+            self.flush()
+        return self._client.learner_step >= steps
+
+
+class RemoteStats:
+    """ActorStats.add surface: forwards accumulator increments inline —
+    actors already batch their stats on the ``actor_freq`` cadence
+    (agents/actor.py flush_stats), so one RPC per flush is the right
+    granularity."""
+
+    def __init__(self, client: DcnClient):
+        self._client = client
+
+    def add(self, **kv: float) -> None:
+        try:
+            self._client.tick(stats={k: float(v) for k, v in kv.items()})
+        except (ConnectionError, OSError):
+            pass

@@ -119,8 +119,14 @@ class Topology:
             # learner done (or dead): release every spinning loop
             self.clock.stop.set()
             self._join_all()
+            # transports feeding learner_side must shut before its queue
+            # closes (FleetTopology stops its DCN gateway here)
+            self._pre_close()
             if hasattr(self.handles.learner_side, "close"):
                 self.handles.learner_side.close()
+
+    def _pre_close(self) -> None:
+        """Hook: extra transports to tear down before learner_side closes."""
 
     def _spawn(self, role: str, ind: int, args: tuple) -> None:
         p = _CTX.Process(

@@ -1,0 +1,175 @@
+"""DCN transport tests: wire codec, gateway<->client round trips, and a
+fleet run with remote actors over localhost — the multi-host topology
+exercised in-process (SURVEY.md §4 calls for multi-node simulation; the
+reference has no multi-host anything to test)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import build_options
+from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+from pytorch_distributed_tpu.parallel.dcn import (
+    DcnClient, DcnGateway, RemoteClock, RemoteMemory, RemoteParamStore,
+    RemoteStats, decode_chunk, encode_chunk,
+)
+from pytorch_distributed_tpu.utils.experience import Transition
+
+
+def _transition(i: int, shape=(4,)) -> Transition:
+    return Transition(
+        state0=np.full(shape, i, dtype=np.float32),
+        action=np.int32(i % 3),
+        reward=np.float32(0.5 * i),
+        gamma_n=np.float32(0.99),
+        state1=np.full(shape, i + 1, dtype=np.float32),
+        terminal1=np.float32(i % 2),
+    )
+
+
+class TestChunkCodec:
+    def test_round_trip_preserves_fields_and_priorities(self):
+        items = [(_transition(i), None if i % 2 else float(i)) for i in
+                 range(5)]
+        out = decode_chunk(encode_chunk(items))
+        assert len(out) == 5
+        for (t0, p0), (t1, p1) in zip(items, out):
+            for f in Transition._fields:
+                np.testing.assert_array_equal(np.asarray(getattr(t0, f)),
+                                              np.asarray(getattr(t1, f)))
+            assert (p0 is None) == (p1 is None)
+            if p0 is not None:
+                assert p0 == pytest.approx(p1)
+
+    def test_uint8_states_survive(self):
+        t = Transition(
+            state0=np.arange(8, dtype=np.uint8).reshape(2, 4),
+            action=np.int32(1), reward=np.float32(1.0),
+            gamma_n=np.float32(0.9),
+            state1=np.arange(8, 16, dtype=np.uint8).reshape(2, 4),
+            terminal1=np.float32(0.0))
+        [(t1, _)] = decode_chunk(encode_chunk([(t, None)]))
+        assert t1.state0.dtype == np.uint8
+        np.testing.assert_array_equal(t1.state0, t.state0)
+
+
+@pytest.fixture()
+def gateway():
+    clock = GlobalClock()
+    stats = ActorStats()
+    store = ParamStore(16)
+    chunks = []
+    gw = DcnGateway(store, clock, stats, put_chunk=chunks.append,
+                    host="127.0.0.1", port=0)
+    yield gw, store, clock, stats, chunks
+    gw.close()
+
+
+class TestGateway:
+    def test_experience_flows_to_put_chunk(self, gateway):
+        gw, _store, _clock, _stats, chunks = gateway
+        client = DcnClient(("127.0.0.1", gw.port))
+        mem = RemoteMemory(client, chunk=3)
+        for i in range(7):
+            mem.feed(_transition(i), None)
+        mem.flush()
+        client.close()
+        deadline = time.monotonic() + 5
+        while sum(len(c) for c in chunks) < 7:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        got = [t for c in chunks for t, _ in c]
+        assert len(got) == 7
+        np.testing.assert_array_equal(got[4].state0,
+                                      np.full((4,), 4, dtype=np.float32))
+
+    def test_param_fetch_versions(self, gateway):
+        gw, store, _clock, _stats, _chunks = gateway
+        client = DcnClient(("127.0.0.1", gw.port))
+        ps = RemoteParamStore(client)
+        assert ps.fetch(0) is None  # nothing published yet
+        flat0 = np.arange(16, dtype=np.float32)
+        store.publish(flat0)
+        flat, version = ps.wait(0, timeout=5)
+        assert version == 1
+        np.testing.assert_array_equal(flat, flat0)
+        assert ps.fetch(version) is None  # no newer snapshot
+        store.publish(flat0 * 2)
+        flat2, v2 = ps.fetch(version)
+        assert v2 == 2
+        np.testing.assert_array_equal(flat2, flat0 * 2)
+        client.close()
+
+    def test_clock_and_stats_aggregate(self, gateway):
+        gw, _store, clock, stats, _chunks = gateway
+        client = DcnClient(("127.0.0.1", gw.port))
+        rclock = RemoteClock(client, flush_every=4)
+        rstats = RemoteStats(client)
+        for _ in range(9):
+            rclock.add_actor_steps(1)
+        rclock.flush()
+        assert clock.actor_step.value == 9
+        rstats.add(nepisodes=2, total_reward=5.0)
+        drained = stats.drain()
+        assert drained["nepisodes"] == 2
+        assert drained["total_reward"] == pytest.approx(5.0)
+        # learner step propagates back; stop flag terminates done()
+        clock.set_learner_step(123)
+        rclock.flush()
+        assert rclock.learner_step.value == 123
+        assert rclock.done(steps=100)
+        assert not client.stop.is_set()
+        clock.stop.set()
+        rclock.flush()
+        assert client.stop.is_set()
+        client.close()
+
+    def test_client_stop_on_gateway_death(self, gateway):
+        gw, _store, _clock, _stats, _chunks = gateway
+        client = DcnClient(("127.0.0.1", gw.port))
+        rclock = RemoteClock(client, flush_every=1)
+        gw.close()
+        # the next flush hits a dead socket: stop must trip, not hang
+        deadline = time.monotonic() + 10
+        while not client.stop.is_set():
+            rclock.add_actor_steps(1)
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        assert rclock.done(steps=10 ** 9)
+
+
+class TestFleetEndToEnd:
+    def test_remote_actors_train_over_localhost(self, tmp_path):
+        """Learner host (thread backend, 0 local actors) + 2 remote actors
+        on localhost: the full Ape-X loop with every shared-plane mechanism
+        replaced by the DCN protocol."""
+        from pytorch_distributed_tpu.fleet import (
+            FleetTopology, _remote_actor_main,
+        )
+
+        opt = build_options(
+            1, num_actors=2, root_dir=str(tmp_path), seed=7,
+            steps=30, learn_start=20, memory_size=512, batch_size=16,
+            actor_freq=25, actor_sync_freq=20, param_publish_freq=10,
+            learner_freq=10, evaluator_freq=1, evaluator_nepisodes=1,
+            checkpoint_freq=0, early_stop=50,
+        )
+        topo = FleetTopology(opt, local_actors=0, port=0)
+        actors = [
+            threading.Thread(
+                target=_remote_actor_main,
+                args=(opt, f"127.0.0.1:{topo.port}", ind), daemon=True)
+            for ind in range(2)
+        ]
+        for t in actors:
+            t.start()
+        topo.run(backend="thread")
+        for t in actors:
+            t.join(30)
+            assert not t.is_alive()
+        assert topo.clock.learner_step.value >= 30
+        assert topo.clock.actor_step.value > 0
+        assert topo.gateway.chunks_in > 0

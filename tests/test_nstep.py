@@ -111,9 +111,7 @@ def test_actor_side_per_priorities():
     opt.agent_params.gamma = 0.5
     spec = probe_env(opt)
     mem = _RecordingMemory()
-    store = ParamStore(4)
-    # publish dummy params matching a 4-param flattener? harness unravels
-    # real model params; publish the actor's own init so wait() returns.
+    # publish the actor's own init so the harness's startup wait() returns
     model = build_model(opt, spec)
     p0 = init_params(opt, spec, model, seed=123)
     flat, _ = make_flattener(p0)
