@@ -26,6 +26,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pytorch_distributed_tpu.memory.device_replay import (
+    DeviceReplay, ring_write, round_capacity,
+)
 from pytorch_distributed_tpu.utils.experience import Batch, Transition
 
 
@@ -44,34 +47,31 @@ class PerReplayState(NamedTuple):
 
 def per_feed(state: PerReplayState, chunk: Transition,
              capacity: int) -> PerReplayState:
-    """Ingest a chunk at the cursor; new rows take the running max
-    priority."""
-    n = chunk.reward.shape[0]
-    idx = (state.pos + jnp.arange(n, dtype=jnp.int32)) % capacity
-    return PerReplayState(
-        state0=state.state0.at[idx].set(chunk.state0),
-        action=state.action.at[idx].set(chunk.action),
-        reward=state.reward.at[idx].set(chunk.reward),
-        gamma_n=state.gamma_n.at[idx].set(chunk.gamma_n),
-        state1=state.state1.at[idx].set(chunk.state1),
-        terminal1=state.terminal1.at[idx].set(chunk.terminal1),
-        priority=state.priority.at[idx].set(state.max_priority),
-        max_priority=state.max_priority,
-        pos=(state.pos + n) % capacity,
-        fill=jnp.minimum(state.fill + n, capacity),
-    )
+    """Ingest a chunk at the cursor (shared ring write, device_replay.py
+    ring_write); new rows take the running max priority."""
+    new, idx = ring_write(state, chunk, capacity)
+    return new._replace(priority=new.priority.at[idx].set(new.max_priority))
 
 
 def per_sample(state: PerReplayState, key: jax.Array, batch_size: int,
-               beta: jax.Array) -> Batch:
-    """Proportional sample + IS weights, all on device."""
+               beta: jax.Array, sample_fn=None) -> Batch:
+    """Proportional sample + IS weights, all on device.
+
+    ``sample_fn(priority, key, batch_size) -> (idx, probs)`` overrides the
+    index draw — the hook the Pallas hierarchical sampler
+    (ops/pallas_sampling.py) plugs into on unsharded TPU rings; None keeps
+    the flat cumsum+searchsorted XLA scheme."""
     p = state.priority  # empty rows hold 0 and can never be drawn
-    cdf = jnp.cumsum(p)
-    total = cdf[-1]
-    u = jax.random.uniform(key, (batch_size,)) * total
-    idx = jnp.clip(jnp.searchsorted(cdf, u, side="right"),
-                   0, state.priority.shape[0] - 1).astype(jnp.int32)
-    probs = p[idx] / jnp.maximum(total, 1e-12)
+    if sample_fn is not None:
+        idx, probs = sample_fn(p, key, batch_size)
+        total = jnp.sum(p)
+    else:
+        cdf = jnp.cumsum(p)
+        total = cdf[-1]  # one O(N) pass serves both u-scaling and probs
+        u = jax.random.uniform(key, (batch_size,)) * total
+        idx = jnp.clip(jnp.searchsorted(cdf, u, side="right"),
+                       0, state.priority.shape[0] - 1).astype(jnp.int32)
+        probs = p[idx] / jnp.maximum(total, 1e-12)
     fill = jnp.maximum(state.fill.astype(jnp.float32), 1.0)
     weights = (fill * jnp.maximum(probs, 1e-12)) ** (-beta)
     # max weight = weight of the min-probability VALID row
@@ -101,8 +101,10 @@ def per_update_priorities(state: PerReplayState, idx: jax.Array,
     )
 
 
-class DevicePerReplay:
-    """Stateful wrapper owning the HBM PER ring (learner process only).
+class DevicePerReplay(DeviceReplay):
+    """Stateful wrapper owning the HBM PER ring (learner process only):
+    the uniform ring (device_replay.py DeviceReplay) extended with the
+    priority vector and the running max.
 
     ``build_fused_step`` wraps a ``(TrainState, Batch) -> (TrainState,
     metrics, td_abs)`` train step into ``(TrainState, PerReplayState, key,
@@ -117,57 +119,42 @@ class DevicePerReplay:
                  importance_weight: float = 0.4,
                  importance_anneal_steps: int = 500000,
                  mesh: Optional[jax.sharding.Mesh] = None):
-        self.capacity = capacity
-        self.state_dtype = np.dtype(state_dtype)
-        self.action_dtype = np.dtype(action_dtype)
         self.alpha = priority_exponent
         self.beta0 = importance_weight
         self.beta_steps = importance_anneal_steps
-        self._row_sharding = None
-        self._scalar_sharding = None
-        if mesh is not None:
-            ndev = mesh.shape["dp"]
-            if capacity % ndev:
-                # same rounding contract as DeviceReplayIngest.attach
-                rounded = capacity + ndev - capacity % ndev
-                import warnings
+        super().__init__(round_capacity(capacity, mesh, label="device PER"),
+                         state_shape, action_shape, state_dtype,
+                         action_dtype, mesh=mesh)
 
-                warnings.warn(
-                    f"device PER capacity {capacity} rounded up to "
-                    f"{rounded} (multiple of mesh dp={ndev})", stacklevel=2)
-                capacity = self.capacity = rounded
-            P = jax.sharding.PartitionSpec
-            self._row_sharding = jax.sharding.NamedSharding(mesh, P("dp"))
-            self._scalar_sharding = jax.sharding.NamedSharding(mesh, P())
+        # Pallas hierarchical sampler on unsharded TPU rings; the flat XLA
+        # scheme everywhere else (dp-sharded rings address rows through
+        # collectives the kernel can't, and CPU interpret mode is slower
+        # than XLA's cumsum).
+        self._draw_fn = None
+        if (self._row_sharding is None
+                and jax.devices()[0].platform == "tpu"):
+            from pytorch_distributed_tpu.ops.pallas_sampling import (
+                hierarchical_sample,
+            )
 
-        def alloc(shape, dtype, sharded=True):
-            arr = jnp.zeros(shape, dtype=dtype)
-            if self._row_sharding is not None:
-                arr = jax.device_put(
-                    arr,
-                    self._row_sharding if sharded else self._scalar_sharding)
-            return arr
+            self._draw_fn = hierarchical_sample
 
-        N = capacity
-        self.state = PerReplayState(
-            state0=alloc((N, *state_shape), jnp.dtype(state_dtype)),
-            action=alloc((N, *action_shape), jnp.dtype(action_dtype)),
-            reward=alloc((N,), jnp.float32),
-            gamma_n=alloc((N,), jnp.float32),
-            state1=alloc((N, *state_shape), jnp.dtype(state_dtype)),
-            terminal1=alloc((N,), jnp.float32),
-            priority=alloc((N,), jnp.float32),
-            max_priority=alloc((), jnp.float32, sharded=False) + 1.0,
-            pos=alloc((), jnp.int32, sharded=False),
-            fill=alloc((), jnp.int32, sharded=False),
-        )
         self._feed_fn = jax.jit(
-            functools.partial(per_feed, capacity=capacity),
+            functools.partial(per_feed, capacity=self.capacity),
             donate_argnums=0)
-        self._sample_fn = jax.jit(per_sample, static_argnames="batch_size")
+        self._sample_fn = jax.jit(
+            functools.partial(per_sample, sample_fn=self._draw_fn),
+            static_argnames="batch_size")
 
-    def feed_chunk(self, chunk: Transition) -> None:
-        self.state = self._feed_fn(self.state, chunk)
+    def _init_state(self) -> PerReplayState:
+        base = super()._init_state()
+        return PerReplayState(
+            *base[:6],
+            priority=self._alloc((self.capacity,), jnp.float32),
+            max_priority=self._alloc((), jnp.float32, sharded=False) + 1.0,
+            pos=base.pos,
+            fill=base.fill,
+        )
 
     def beta(self, step: int) -> float:
         frac = min(1.0, step / max(1, self.beta_steps))
@@ -177,8 +164,10 @@ class DevicePerReplay:
                          donate: bool = True):
         alpha = self.alpha
 
+        draw_fn = self._draw_fn
+
         def fused(ts, rs: PerReplayState, key, beta):
-            batch = per_sample(rs, key, batch_size, beta)
+            batch = per_sample(rs, key, batch_size, beta, sample_fn=draw_fn)
             ts, metrics, td_abs = train_step(ts, batch)
             rs = per_update_priorities(rs, batch.index, td_abs, alpha)
             return ts, rs, metrics

@@ -39,10 +39,14 @@ class ReplayState(NamedTuple):
     fill: jax.Array       # int32 number of valid rows
 
 
-def _feed(state: ReplayState, chunk: Transition, capacity: int) -> ReplayState:
+def ring_write(state, chunk: Transition, capacity: int):
+    """Write a chunk at the cursor of ANY ring state carrying the six-array
+    schema plus pos/fill (ReplayState, and device_per.py's PerReplayState).
+    Returns (state', idx) so extended schemas can set their extra
+    per-row fields at the same slots."""
     n = chunk.reward.shape[0]
     idx = (state.pos + jnp.arange(n, dtype=jnp.int32)) % capacity
-    return ReplayState(
+    return state._replace(
         state0=state.state0.at[idx].set(chunk.state0),
         action=state.action.at[idx].set(chunk.action),
         reward=state.reward.at[idx].set(chunk.reward),
@@ -51,7 +55,30 @@ def _feed(state: ReplayState, chunk: Transition, capacity: int) -> ReplayState:
         terminal1=state.terminal1.at[idx].set(chunk.terminal1),
         pos=(state.pos + n) % capacity,
         fill=jnp.minimum(state.fill + n, capacity),
-    )
+    ), idx
+
+
+def _feed(state: ReplayState, chunk: Transition, capacity: int) -> ReplayState:
+    return ring_write(state, chunk, capacity)[0]
+
+
+def round_capacity(capacity: int, mesh: Optional[jax.sharding.Mesh],
+                   axis: str = "dp", label: str = "device replay") -> int:
+    """Round capacity up to a multiple of the mesh axis so ring rows split
+    evenly across devices (e.g. the default 50000 on a 32-wide mesh ->
+    50016)."""
+    if mesh is None:
+        return capacity
+    ndev = mesh.shape[axis]
+    if capacity % ndev:
+        rounded = capacity + ndev - capacity % ndev
+        import warnings
+
+        warnings.warn(
+            f"{label} capacity {capacity} rounded up to {rounded} "
+            f"(multiple of mesh {axis}={ndev})", stacklevel=3)
+        return rounded
+    return capacity
 
 
 def sample_rows(state: ReplayState, key: jax.Array,
@@ -109,16 +136,17 @@ class DeviceReplay:
         self._sample_fn = jax.jit(
             sample_rows, static_argnames="batch_size", donate_argnums=())
 
+    def _alloc(self, shape, dtype, sharded: bool = True):
+        arr = jnp.zeros(shape, dtype=dtype)
+        if self._row_sharding is not None:
+            arr = jax.device_put(
+                arr,
+                self._row_sharding if sharded else self._scalar_sharding)
+        return arr
+
     def _init_state(self) -> ReplayState:
         N = self.capacity
-
-        def alloc(shape, dtype, sharded=True):
-            arr = jnp.zeros(shape, dtype=dtype)
-            if self._row_sharding is not None:
-                arr = jax.device_put(
-                    arr, self._row_sharding if sharded else self._scalar_sharding)
-            return arr
-
+        alloc = self._alloc
         return ReplayState(
             state0=alloc((N, *self.state_shape), self.state_dtype),
             action=alloc((N, *self.action_shape), self.action_dtype),
@@ -192,19 +220,7 @@ class DeviceReplayIngest:
                ) -> DeviceReplay:
         """Allocate the HBM ring on the learner's mesh (geometry was fixed
         at construction by the memory factory)."""
-        capacity = self.capacity
-        if mesh is not None:
-            # round capacity up so rows split evenly across the dp axis
-            # (e.g. the default 50000 on a 32-wide mesh -> 50016)
-            ndev = mesh.shape["dp"]
-            if capacity % ndev:
-                rounded = capacity + ndev - capacity % ndev
-                import warnings
-
-                warnings.warn(
-                    f"device replay capacity {capacity} rounded up to "
-                    f"{rounded} (multiple of mesh dp={ndev})", stacklevel=2)
-                capacity = rounded
+        capacity = round_capacity(self.capacity, mesh)
         self.replay = DeviceReplay(
             capacity, self.state_shape, self.action_shape,
             self.state_dtype, self.action_dtype, mesh=mesh)
