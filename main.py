@@ -46,11 +46,18 @@ def parse_args(argv=None):
     p.add_argument("--no-tensorboard", action="store_true")
     p.add_argument("--dp-size", type=int, default=-1,
                    help="learner mesh data-parallel width (-1 = all devices)")
+    p.add_argument("--set", action="append", default=[], metavar="K=V",
+                   help="any Options override, e.g. --set seq_len=16 "
+                        "--set lr=2e-3 (repeatable)")
     return p.parse_args(argv)
 
 
 def options_from_args(args):
+    from pytorch_distributed_tpu.config import parse_set_overrides
+
     overrides = dict(mode=args.mode, seed=args.seed)
+    # --set wins over flag defaults (and may name the same keys)
+    overrides.update(parse_set_overrides(args.set))
     if args.num_actors is not None:
         overrides["num_actors"] = args.num_actors
     if args.num_envs_per_actor is not None:

@@ -135,17 +135,24 @@ class _ActorHarness:
             self.episode_steps[j] += 1
             self.episode_reward[j] += float(rewards[j])
             if terminals[j]:
-                solved = bool(infos[j].get(
-                    "solved", self.episode_reward[j] > 0))
-                self._acc["nepisodes"] += 1
-                self._acc["nepisodes_solved"] += float(solved)
-                self._acc["total_steps"] += float(self.episode_steps[j])
-                self._acc["total_reward"] += float(self.episode_reward[j])
-                self.episode_steps[j] = 0
-                self.episode_reward[j] = 0.0
+                self._record_episode(j, infos[j])
                 self.on_env_reset(j)
         self._obs = next_obs
+        self._run_cadences()
 
+    def _record_episode(self, j: int, info: dict) -> None:
+        """Fold env slot j's finished episode into the stat accumulators."""
+        solved = bool(info.get("solved", self.episode_reward[j] > 0))
+        self._acc["nepisodes"] += 1
+        self._acc["nepisodes_solved"] += float(solved)
+        self._acc["total_steps"] += float(self.episode_steps[j])
+        self._acc["total_reward"] += float(self.episode_reward[j])
+        self.episode_steps[j] = 0
+        self.episode_reward[j] = 0.0
+
+    def _run_cadences(self) -> None:
+        """Per-tick counter bump + the stat-flush and weight-sync cadences
+        (reference dqn_actor.py:166-192)."""
         N = self.num_envs
         self.env_steps += N
         self.clock.add_actor_steps(N)  # reference dqn_actor.py:166-167

@@ -32,7 +32,8 @@ def greedy_episodes(opt: Options, spec: EnvSpec, model, params, env,
                     nepisodes: int) -> Tuple[float, float, int]:
     """Run n greedy episodes; returns (avg_steps, avg_reward, solved).
     Greedy = eps 0 for DQN (reference evaluators.py:56-86), noiseless policy
-    forward for DDPG."""
+    forward for DDPG, zero-carry recurrent greedy for R2D2."""
+    on_reset = lambda: None  # recurrent policies re-bind this per episode
     if opt.agent_type == "dqn":
         from pytorch_distributed_tpu.models.policies import build_greedy_act
 
@@ -41,6 +42,21 @@ def greedy_episodes(opt: Options, spec: EnvSpec, model, params, env,
         def pick(obs):
             a, _ = act(params, obs[None])
             return int(a[0])
+    elif opt.agent_type == "r2d2":
+        from pytorch_distributed_tpu.models.policies import (
+            build_recurrent_greedy_act,
+        )
+
+        ract = build_recurrent_greedy_act(model.apply)
+        carry_box = [model.zero_carry(1)]
+
+        def pick(obs):
+            a, carry_box[0] = ract(params, obs[None], carry_box[0])
+            return int(a[0])
+
+        def _reset_carry():
+            carry_box[0] = model.zero_carry(1)
+        on_reset = _reset_carry
     else:
         from pytorch_distributed_tpu.models.policies import build_ddpg_act
 
@@ -52,6 +68,7 @@ def greedy_episodes(opt: Options, spec: EnvSpec, model, params, env,
 
     total_steps, total_reward, solved = 0, 0.0, 0
     for _ in range(nepisodes):
+        on_reset()
         obs = env.reset()
         ep_reward, ep_steps, terminal, info = 0.0, 0, False, {}
         while not terminal:

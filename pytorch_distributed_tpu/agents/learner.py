@@ -138,9 +138,11 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     clock.set_learner_step(lstep)
 
     # ---- gate until the replay warms up (reference dqn_learner.py:51) ----
-    # clamped to capacity: a learn_start >= memory_size would otherwise spin
-    # forever since a full ring's size never exceeds its capacity
-    learn_start = min(ap.learn_start, opt.memory_params.memory_size - 1)
+    # clamped to the actual buffer capacity (segments for sequence replay,
+    # transitions elsewhere): a learn_start >= capacity would otherwise
+    # spin forever since a full ring's size never exceeds its capacity
+    cap = getattr(memory, "capacity", opt.memory_params.memory_size)
+    learn_start = min(ap.learn_start, cap - 1)
     while not clock.done(ap.steps) and memory_size(memory) <= learn_start:
         time.sleep(0.05)
 

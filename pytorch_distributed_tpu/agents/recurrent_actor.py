@@ -1,0 +1,119 @@
+"""Recurrent (R2D2) rollout workers.
+
+Same Ape-X topology as agents/actor.py — vectorized envs, per-slot epsilon
+schedule, versioned weight pulls, stat cadences — but the policy carries an
+LSTM state across steps and experience leaves as overlapping episode
+SEGMENTS (memory/sequence_replay.py SegmentBuilder), not n-step
+transitions.  The carry recorded with each step is the state BEFORE acting,
+which is what the stored-state burn-in strategy replays from
+(ops/sequence_losses.py docstring).
+
+Episode boundaries reset both the env slot's carry (to the model's zero
+state) and its segment stream.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from pytorch_distributed_tpu.config import Options
+from pytorch_distributed_tpu.factory import (
+    EnvSpec, build_env_vector, build_model, init_params,
+)
+from pytorch_distributed_tpu.agents.actor import _ActorHarness
+from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+from pytorch_distributed_tpu.memory.sequence_replay import SegmentBuilder
+from pytorch_distributed_tpu.utils.rngs import process_key
+
+
+class _RecurrentHarness(_ActorHarness):
+    """Actor harness with the n-step assemblers swapped for per-env
+    SegmentBuilders and a persistent LSTM carry per env slot."""
+
+    def __init__(self, opt: Options, spec: EnvSpec, process_ind: int,
+                 memory: Any, param_store: ParamStore, clock: GlobalClock,
+                 stats: ActorStats):
+        super().__init__(opt, spec, process_ind, memory, param_store, clock,
+                         stats)
+        ap = self.ap
+        state_dtype = (np.uint8 if opt.memory_params.state_dtype == "uint8"
+                       else np.float32)
+        self.builders = [
+            SegmentBuilder(ap.seq_len, ap.seq_overlap,
+                           state_dtype=state_dtype)
+            for _ in range(self.num_envs)]
+        # one batched carry; per-env rows reset at episode ends
+        self.carry = tuple(np.asarray(c) for c in
+                           self.model.zero_carry(self.num_envs))
+
+    # segments replace transitions: override the per-env feed
+    def advance(self, actions, next_obs, rewards, terminals, infos,
+                carry_before=None, carry_after=None) -> None:
+        for j in range(self.num_envs):
+            true_next = infos[j].get("final_obs", next_obs[j])
+            truncated = bool(infos[j].get("truncated", False))
+            per_env_carry = (carry_before[0][j], carry_before[1][j])
+            for seg in self.builders[j].push(
+                    self._obs[j], int(actions[j]), float(rewards[j]),
+                    # time-limit truncation ends the segment but must
+                    # bootstrap through (not a death) — same distinction
+                    # the n-step assembler draws for feed()
+                    bool(terminals[j]) and not truncated, true_next,
+                    per_env_carry, episode_end=bool(terminals[j])):
+                self.memory.feed(seg, None)
+            self.episode_steps[j] += 1
+            self.episode_reward[j] += float(rewards[j])
+            if terminals[j]:
+                self._record_episode(j, infos[j])
+                # fresh episode: zero carry + fresh segment stream
+                zc = self.model.zero_carry(1)
+                carry_after[0][j] = np.asarray(zc[0])[0]
+                carry_after[1][j] = np.asarray(zc[1])[0]
+                self.builders[j].reset()
+        self._obs = next_obs
+        self.carry = carry_after
+        self._run_cadences()
+
+    def shutdown(self) -> None:
+        self.flush_stats()
+        if hasattr(self.memory, "flush"):
+            self.memory.flush()
+        self._timing_writer.close()
+
+
+def run_r2d2_actor(opt: Options, spec: EnvSpec, process_ind: int,
+                   memory: Any, param_store: ParamStore, clock: GlobalClock,
+                   stats: ActorStats) -> None:
+    """eps-greedy recurrent rollout worker, batched over the env vector."""
+    import jax
+
+    from pytorch_distributed_tpu.models.policies import (
+        apex_epsilons, build_recurrent_epsilon_greedy_act,
+    )
+
+    h = _RecurrentHarness(opt, spec, process_ind, memory, param_store,
+                          clock, stats)
+    act = build_recurrent_epsilon_greedy_act(h.model.apply)
+    eps = apex_epsilons(process_ind, opt.num_actors, h.num_envs,
+                        h.ap.eps, h.ap.eps_alpha)
+    key = process_key(opt.seed, "actor", process_ind)
+
+    h.start()
+    while not clock.done(h.ap.steps):
+        key, sub = jax.random.split(key)
+        carry_before = h.carry
+        with h.timer.phase("act"):
+            a, carry_after = act(h.params, h._obs, carry_before, sub, eps)
+            actions = np.asarray(a)
+            # np.array (copy): zero-copy views of jax buffers are
+            # read-only, and episode resets write per-env rows in place
+            carry_after = [np.array(c) for c in carry_after]
+        with h.timer.phase("env"):
+            next_obs, rewards, terminals, infos = h.env.step(actions)
+        with h.timer.phase("advance"):
+            h.advance(actions, next_obs, rewards, terminals, infos,
+                      carry_before=carry_before, carry_after=carry_after)
+    h.shutdown()

@@ -49,6 +49,8 @@ CONFIGS = [
     ["ddpg",      "gym",       "humanoid",    "shared",      "ddpg-mlp"],# 10 (BASELINE config 5; needs gym+mujoco)
     ["dqn",       "atari",     "breakout",    "device",      "dqn-cnn"], # 11 Atari-57 sweep row (needs ALE)
     ["dqn",       "pong-sim",  "pong",        "device-per",  "dqn-cnn"], # 12 HBM PER, fully fused
+    ["r2d2",      "fake",      "chain",       "sequence",    "drqn-mlp"],# 13 recurrent smoke
+    ["r2d2",      "pong-sim",  "pong",        "sequence",    "drqn-cnn"],# 14 R2D2 pixels
 ]
 
 
@@ -116,6 +118,7 @@ class ModelParams:
 
     model_type: str = "dqn-cnn"
     hidden_dim: int = 256              # dqn-mlp width (reference dqn_mlp_model.py:18-26)
+    lstm_dim: int = 256                # recurrent core width (drqn-* models)
     # Apply orthogonal init for the CNN.  The reference *defines* orthogonal
     # init but never applies it (dqn_cnn_model.py:33 commented out) — here it
     # is applied and this flag documents the deliberate divergence.
@@ -171,6 +174,14 @@ class AgentParams:
     eps: float = 0.4                   # Ape-X per-actor epsilon base
     eps_alpha: float = 7.0
     eps_eval: float = 0.0              # greedy at eval
+    # --- r2d2 specifics (no reference equivalent; Kapturowski et al. 2019
+    # defaults — the sequence family extends the reference's capability
+    # set, SURVEY.md §5 "long-context" note) ---
+    seq_len: int = 80                  # replay segment length
+    seq_overlap: int = 40              # segment overlap (adjacent windows)
+    burn_in: int = 40                  # stored-state refresh prefix
+    value_rescale: bool = True         # h(x) target transform
+    priority_eta: float = 0.9          # max/mean blend for seq priorities
     # --- ddpg specifics (reference :167-168 + random_process.py) ---
     critic_lr: float = 1e-3
     ou_theta: float = 0.15
@@ -189,6 +200,16 @@ def build_agent_params(agent_type: str, **overrides: Any) -> AgentParams:
     utils/options.py:111-168."""
     if agent_type == "dqn":
         p = AgentParams(agent_type="dqn")
+    elif agent_type == "r2d2":
+        # R2D2 paper cadences; learn_start/batch count SEGMENTS here
+        p = AgentParams(
+            agent_type="r2d2",
+            enable_double=True,
+            nstep=5,
+            batch_size=64,
+            learn_start=64,
+            target_model_update=2500,
+        )
     elif agent_type == "ddpg":
         p = AgentParams(
             agent_type="ddpg",
@@ -269,6 +290,23 @@ class Options:
         return os.path.join(self.root_dir, "logs", self.refs)
 
 
+def parse_set_overrides(pairs) -> dict:
+    """Parse repeatable CLI ``--set key=value`` pairs into an overrides
+    dict (int/float auto-typed, else string) — shared by main.py and the
+    fleet launcher."""
+    out = {}
+    for kv in pairs:
+        k, _, v = kv.partition("=")
+        for cast in (int, float):
+            try:
+                v = cast(v)
+                break
+            except ValueError:
+                continue
+        out[k] = v
+    return out
+
+
 def build_options(config: int = 1, **overrides: Any) -> Options:
     """Construct an Options from a CONFIGS row index + keyword overrides.
 
@@ -310,6 +348,10 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
             memory_type=memory_type,
             state_dtype=state_dtype,
             enable_per=(memory_type == "prioritized"),
+            # sequence replay is prioritized by default with the R2D2
+            # constants (alpha 0.9 / beta0 0.6); --set overrides still land
+            **({"priority_exponent": 0.9, "priority_weight": 0.6}
+               if memory_type == "sequence" else {}),
         ),
         model_params=ModelParams(model_type=model_type),
         agent_params=build_agent_params(agent_type),

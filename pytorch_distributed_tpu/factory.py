@@ -52,11 +52,12 @@ MemoriesDict: Dict[str, Optional[Callable]] = {
     "prioritized": PrioritizedReplay,  # finishes the reference's PER TODO
     "device": None,                    # HBM-resident ring (device_replay.py)
     "device-per": None,                # HBM prioritized ring (device_per.py)
+    "sequence": None,                  # episode segments (sequence_replay.py)
     "none": None,                      # reference factory.py:38
 }
 
 # model ctors bound in build_model below (they need probed shapes)
-ModelTypes = ("dqn-cnn", "dqn-mlp", "ddpg-mlp")
+ModelTypes = ("dqn-cnn", "dqn-mlp", "ddpg-mlp", "drqn-mlp", "drqn-cnn")
 
 
 def _worker_dicts():
@@ -67,20 +68,26 @@ def _worker_dicts():
     from pytorch_distributed_tpu.agents import evaluator as _evaluator
     from pytorch_distributed_tpu.agents import learner as _learner
     from pytorch_distributed_tpu.agents import logger as _logger
+    from pytorch_distributed_tpu.agents import recurrent_actor as _ractor
     from pytorch_distributed_tpu.agents import tester as _tester
 
     return {
-        # reference utils/factory.py:22-31
+        # reference utils/factory.py:22-31 (+ the r2d2 family extension)
         "actors": {"dqn": _actor.run_dqn_actor,
-                   "ddpg": _actor.run_ddpg_actor},
+                   "ddpg": _actor.run_ddpg_actor,
+                   "r2d2": _ractor.run_r2d2_actor},
         "learners": {"dqn": _learner.run_learner,
-                     "ddpg": _learner.run_learner},
+                     "ddpg": _learner.run_learner,
+                     "r2d2": _learner.run_learner},
         "evaluators": {"dqn": _evaluator.run_evaluator,
-                       "ddpg": _evaluator.run_evaluator},
+                       "ddpg": _evaluator.run_evaluator,
+                       "r2d2": _evaluator.run_evaluator},
         "testers": {"dqn": _tester.run_tester,
-                    "ddpg": _tester.run_tester},
+                    "ddpg": _tester.run_tester,
+                    "r2d2": _tester.run_tester},
         "loggers": {"dqn": _logger.run_logger,
-                    "ddpg": _logger.run_logger},
+                    "ddpg": _logger.run_logger,
+                    "r2d2": _logger.run_logger},
     }
 
 
@@ -147,6 +154,13 @@ def probe_env(opt: Options) -> EnvSpec:
 # Model builders
 # ---------------------------------------------------------------------------
 
+def lstm_dim_of(opt: Options) -> int:
+    """Recurrent core width for the configured model (the CNN variant
+    floors at 512, matching its torso output)."""
+    d = opt.model_params.lstm_dim
+    return max(d, 512) if opt.model_type == "drqn-cnn" else d
+
+
 def build_model(opt: Options, spec: EnvSpec):
     """Flax module for the configured model_type (reference factory.py:42-43
     + model ctor calls in main.py:44)."""
@@ -174,6 +188,20 @@ def build_model(opt: Options, spec: EnvSpec):
         assert not spec.discrete, "ddpg-mlp needs a continuous action space"
         return DdpgMlpModel(action_dim=spec.action_dim,
                             norm_val=spec.norm_val)
+    if opt.model_type == "drqn-mlp":
+        from pytorch_distributed_tpu.models.drqn import DrqnMlpModel
+
+        return DrqnMlpModel(action_space=spec.num_actions,
+                            hidden_dim=mp_.hidden_dim,
+                            lstm_dim=mp_.lstm_dim,
+                            norm_val=spec.norm_val)
+    if opt.model_type == "drqn-cnn":
+        from pytorch_distributed_tpu.models.drqn import DrqnCnnModel
+
+        return DrqnCnnModel(action_space=spec.num_actions,
+                            lstm_dim=lstm_dim_of(opt),
+                            norm_val=spec.norm_val,
+                            compute_dtype=jnp.dtype(mp_.compute_dtype))
     raise ValueError(f"unknown model_type: {opt.model_type}")
 
 
@@ -213,6 +241,30 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params):
 
     ap = opt.agent_params
     decay = ap.steps if ap.lr_decay else 0
+    if opt.agent_type == "r2d2":
+        from pytorch_distributed_tpu.ops.sequence_losses import (
+            build_drqn_train_step,
+        )
+
+        assert ap.burn_in < ap.seq_len, (
+            f"burn_in={ap.burn_in} must leave a train window inside "
+            f"seq_len={ap.seq_len} (did a --set seq_len override forget "
+            f"burn_in?)")
+        tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay,
+                            lr_decay_steps=decay)
+        state = init_train_state(params, tx)
+        step = build_drqn_train_step(
+            model.apply, tx,
+            burn_in=ap.burn_in,
+            nstep=ap.nstep,
+            gamma=ap.gamma,
+            enable_double=ap.enable_double,
+            target_model_update=ap.target_model_update,
+            rescale_values=ap.value_rescale,
+            priority_eta=ap.priority_eta,
+        )
+        return state, step
+
     if opt.agent_type == "dqn":
         tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay,
                             lr_decay_steps=decay)
@@ -318,6 +370,29 @@ def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
             importance_anneal_steps=opt.agent_params.steps,
         )
         owner = QueueOwner(per)
+        return MemoryHandles(actor_side=owner.make_feeder(),
+                             learner_side=owner)
+    if opt.memory_type == "sequence":
+        from pytorch_distributed_tpu.memory.sequence_replay import (
+            SequenceReplay,
+        )
+
+        ap = opt.agent_params
+        seq = SequenceReplay(
+            # memory_size counts transitions everywhere else; overlapping
+            # windows mean ~seq_len/overlap rows per transition, so divide
+            # by the overlap stride to hold the same history span
+            capacity=max(mp_.memory_size
+                         // max(ap.seq_len - ap.seq_overlap, 1), 16),
+            seq_len=ap.seq_len,
+            state_shape=spec.state_shape,
+            lstm_dim=lstm_dim_of(opt),
+            state_dtype=state_dtype,
+            priority_exponent=mp_.priority_exponent,
+            importance_weight=mp_.priority_weight,
+            importance_anneal_steps=ap.steps * ap.batch_size,
+        )
+        owner = QueueOwner(seq)
         return MemoryHandles(actor_side=owner.make_feeder(),
                              learner_side=owner)
     if opt.memory_type in ("device", "device-per"):

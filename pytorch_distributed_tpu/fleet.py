@@ -183,20 +183,13 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "auto-typed). Must match on every host.")
     args = ap.parse_args(argv)
 
-    overrides = {}
+    from pytorch_distributed_tpu.config import parse_set_overrides
+
+    overrides = parse_set_overrides(args.set)
     if args.num_actors is not None:
         overrides["num_actors"] = args.num_actors
     if args.seed is not None:
         overrides["seed"] = args.seed
-    for kv in args.set:
-        k, _, v = kv.partition("=")
-        for cast in (int, float):
-            try:
-                v = cast(v)
-                break
-            except ValueError:
-                continue
-        overrides[k] = v
     opt = build_options(args.config, **overrides)
 
     if args.role == "learner":

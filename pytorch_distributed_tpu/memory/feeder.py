@@ -84,6 +84,10 @@ class QueueOwner:
     def size(self) -> int:
         return self.memory.size
 
+    @property
+    def capacity(self) -> int:
+        return self.memory.capacity
+
     def sample(self, batch_size: int, rng: np.random.Generator):
         return self.memory.sample(batch_size, rng)
 

@@ -1,0 +1,218 @@
+"""Sequence replay: contiguous episode segments for recurrent learners.
+
+The reference stores single n-step transitions only; SURVEY.md §5 flags
+that the replay layout must not preclude "contiguous episode segments"
+for recurrent/R2D2-style training — this module is that layout.  One row
+is a fixed-length window of an episode:
+
+    obs[T+1], action[T], reward[T], terminal[T], mask[T], (c0, h0)
+
+where ``mask`` marks valid steps (episode tails are zero-padded) and
+``(c0, h0)`` is the actor's recorded LSTM state at the segment's first
+step — the "stored state" strategy of R2D2 (Kapturowski et al. 2019),
+which the learner refreshes with a burn-in prefix
+(ops/sequence_losses.py).
+
+Segments overlap by ``overlap`` steps (R2D2 uses length 80, overlap 40) so
+every step appears in ~T/overlap windows.  Sampling is proportional over
+per-sequence priorities (eta-blended max/mean |TD|, written back by the
+learner) with new rows at the running max — uniform when alpha == 0.
+Single-owner like the host PER buffer: actors stream segments through a
+QueueOwner (memory/feeder.py); only the learner touches the arrays.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+
+class Segment(NamedTuple):
+    """One replay row (unbatched)."""
+
+    obs: np.ndarray        # (T+1, *state_shape)
+    action: np.ndarray     # (T,) int32
+    reward: np.ndarray     # (T,) float32
+    terminal: np.ndarray   # (T,) float32
+    mask: np.ndarray       # (T,) float32, 1 = valid step
+    c0: np.ndarray         # (lstm_dim,) float32
+    h0: np.ndarray         # (lstm_dim,) float32
+
+
+class SegmentBatch(NamedTuple):
+    """A sampled minibatch of segments (leading batch dim everywhere)."""
+
+    obs: np.ndarray        # (B, T+1, *state_shape)
+    action: np.ndarray
+    reward: np.ndarray
+    terminal: np.ndarray
+    mask: np.ndarray
+    c0: np.ndarray         # (B, lstm_dim)
+    h0: np.ndarray
+    weight: np.ndarray     # (B,) importance weights
+    index: np.ndarray      # (B,) rows, for priority write-back
+
+
+class SegmentBuilder:
+    """Per-env online segment assembly with overlap.
+
+    ``push`` receives one acted step — the observation the actor saw, the
+    LSTM carry it held BEFORE acting (the state to store for this step),
+    and the step outcome — and returns zero or more finished Segments.
+    Episode ends flush a padded+masked tail and reset the stream (overlap
+    never crosses episodes)."""
+
+    def __init__(self, seq_len: int, overlap: int,
+                 state_dtype=np.float32):
+        assert 0 <= overlap < seq_len, (overlap, seq_len)
+        self.T = seq_len
+        self.overlap = overlap
+        self.state_dtype = np.dtype(state_dtype)
+        self._steps: List[tuple] = []  # (obs, a, r, term, next_obs, c, h)
+
+    def push(self, obs, action, reward, terminal, next_obs,
+             carry: Tuple[np.ndarray, np.ndarray],
+             episode_end: Optional[bool] = None) -> List[Segment]:
+        """``terminal`` is what the learner bootstraps on (False for
+        time-limit truncations, which must bootstrap through);
+        ``episode_end`` (default: terminal) is what ends the stream — a
+        truncated episode ends the segment without marking a death."""
+        if episode_end is None:
+            episode_end = bool(terminal)
+        c, h = carry
+        self._steps.append((
+            np.asarray(obs), int(action), float(reward), bool(terminal),
+            np.asarray(next_obs), np.asarray(c, np.float32).copy(),
+            np.asarray(h, np.float32).copy()))
+        out: List[Segment] = []
+        if episode_end:
+            out.append(self._emit(len(self._steps)))
+            self._steps = []  # no overlap across episode boundaries
+        elif len(self._steps) == self.T:
+            out.append(self._emit(self.T))
+            keep = self.overlap
+            self._steps = self._steps[len(self._steps) - keep:] if keep \
+                else []
+        return out
+
+    def _emit(self, n: int) -> Segment:
+        T = self.T
+        steps = self._steps[:n]
+        obs0 = steps[0][0]
+        obs = np.zeros((T + 1, *obs0.shape), dtype=self.state_dtype)
+        action = np.zeros(T, np.int32)
+        reward = np.zeros(T, np.float32)
+        terminal = np.zeros(T, np.float32)
+        mask = np.zeros(T, np.float32)
+        for t, (o, a, r, term, nxt, _c, _h) in enumerate(steps):
+            obs[t] = o
+            action[t] = a
+            reward[t] = r
+            terminal[t] = float(term)
+            mask[t] = 1.0
+        obs[n] = steps[n - 1][4]  # bootstrap observation
+        # pad slots keep the bootstrap obs so scans stay shape-static
+        for t in range(n + 1, T + 1):
+            obs[t] = obs[n]
+        return Segment(obs=obs, action=action, reward=reward,
+                       terminal=terminal, mask=mask,
+                       c0=steps[0][5], h0=steps[0][6])
+
+    def reset(self) -> None:
+        self._steps = []
+
+
+class SequenceReplay:
+    """Ring of segments with proportional prioritized sampling.
+
+    ``capacity`` counts SEGMENTS (the factory divides the transition-count
+    memory_size by the segment length)."""
+
+    def __init__(self, capacity: int, seq_len: int,
+                 state_shape: Tuple[int, ...], lstm_dim: int,
+                 state_dtype=np.float32,
+                 priority_exponent: float = 0.9,
+                 importance_weight: float = 0.6,
+                 importance_anneal_steps: int = 500000):
+        self.capacity = capacity
+        self.T = seq_len
+        self.alpha = priority_exponent
+        self.beta0 = importance_weight
+        self.beta_steps = importance_anneal_steps
+        S = tuple(state_shape)
+        self.obs = np.zeros((capacity, seq_len + 1, *S), dtype=state_dtype)
+        self.action = np.zeros((capacity, seq_len), np.int32)
+        self.reward = np.zeros((capacity, seq_len), np.float32)
+        self.terminal = np.zeros((capacity, seq_len), np.float32)
+        self.mask = np.zeros((capacity, seq_len), np.float32)
+        self.c0 = np.zeros((capacity, lstm_dim), np.float32)
+        self.h0 = np.zeros((capacity, lstm_dim), np.float32)
+        self.priority = np.zeros(capacity, np.float64)  # p^alpha, 0 = empty
+        self.max_priority = 1.0
+        self.pos = 0
+        self.full = False
+        self.samples_drawn = 0
+
+    @property
+    def size(self) -> int:
+        return self.capacity if self.full else self.pos
+
+    def feed(self, segment: Segment, priority: Optional[float] = None
+             ) -> None:
+        i = self.pos
+        self.obs[i] = segment.obs
+        self.action[i] = segment.action
+        self.reward[i] = segment.reward
+        self.terminal[i] = segment.terminal
+        self.mask[i] = segment.mask
+        self.c0[i] = segment.c0
+        self.h0[i] = segment.h0
+        if priority is None:
+            self.priority[i] = self.max_priority
+        else:
+            p = (abs(float(priority)) + 1e-6) ** self.alpha
+            self.priority[i] = p
+            self.max_priority = max(self.max_priority, p)
+        self.pos += 1
+        if self.pos == self.capacity:
+            self.pos = 0
+            self.full = True
+
+    def beta(self) -> float:
+        frac = min(1.0, self.samples_drawn / max(1, self.beta_steps))
+        return self.beta0 + (1.0 - self.beta0) * frac
+
+    def sample(self, batch_size: int, rng: np.random.Generator
+               ) -> SegmentBatch:
+        n = self.size
+        assert n > 0, "sample from empty sequence replay"
+        if self.alpha == 0.0:
+            idx = rng.integers(0, n, size=batch_size)
+            weights = np.ones(batch_size, np.float32)
+        else:
+            p = self.priority[:n]
+            total = p.sum()
+            cdf = np.cumsum(p)
+            u = rng.random(batch_size) * total
+            idx = np.minimum(np.searchsorted(cdf, u, side="right"), n - 1)
+            probs = p[idx] / max(total, 1e-12)
+            beta = self.beta()
+            weights = (n * np.maximum(probs, 1e-12)) ** (-beta)
+            min_p = p[p > 0].min() / max(total, 1e-12)
+            weights /= max((n * max(min_p, 1e-12)) ** (-beta), 1e-12)
+            weights = weights.astype(np.float32)
+        self.samples_drawn += batch_size
+        return SegmentBatch(
+            obs=self.obs[idx], action=self.action[idx],
+            reward=self.reward[idx], terminal=self.terminal[idx],
+            mask=self.mask[idx], c0=self.c0[idx], h0=self.h0[idx],
+            weight=weights, index=idx.astype(np.int32))
+
+    def update_priorities(self, indices: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        """Per-sequence |TD| write-back (eta-blended by the learner)."""
+        pr = (np.abs(np.asarray(priorities, np.float64)) + 1e-6) ** self.alpha
+        self.priority[np.asarray(indices)] = pr
+        if pr.size:
+            self.max_priority = max(self.max_priority, float(pr.max()))

@@ -1,0 +1,96 @@
+"""Recurrent Q-networks (R2D2 family).
+
+No reference equivalent — the reference's only sequence notion is the
+n-step window and 4-frame stack (SURVEY.md §5 "long-context: store
+contiguous episode segments, not only single transitions"); this is the
+model side of that extension: an LSTM core over the torso so the Q-function
+conditions on history far beyond the frame stack (Kapturowski et al. 2019,
+"Recurrent Experience Replay in Distributed RL").
+
+Interface contract shared by both variants:
+
+- ``apply(params, obs, carry)`` -> ``(q, carry')`` — one recurrent step on
+  a batch of observations; ``carry`` is the flax LSTM ``(c, h)`` pair.
+- ``apply(params, obs)`` (carry omitted) starts from the zero state, so
+  the factory's ``init_params``/``example_obs`` probe works unchanged.
+- ``zero_carry(batch)`` builds the start-of-episode state; the same zeros
+  are what segment builders record at episode starts.
+
+The time dimension deliberately lives OUTSIDE the module:
+``ops/sequence_losses.unroll`` scans the single-step apply over a
+time-major sequence — keeping the module shape-agnostic and the scan in
+one place XLA can optimise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Carry = Tuple[jnp.ndarray, jnp.ndarray]  # flax LSTM (c, h)
+
+
+class DrqnMlpModel(nn.Module):
+    """MLP torso -> LSTM -> Q head, the low-dim recurrent counterpart of
+    DqnMlpModel (reference core/models/dqn_mlp_model.py's 3x256 ReLU MLP,
+    with the middle layer replaced by the recurrent core)."""
+
+    action_space: int
+    hidden_dim: int = 256
+    lstm_dim: int = 256
+    norm_val: float = 1.0
+
+    def zero_carry(self, batch: int) -> Carry:
+        z = jnp.zeros((batch, self.lstm_dim), dtype=jnp.float32)
+        return (z, z)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray, carry: Optional[Carry] = None
+                 ) -> Tuple[jnp.ndarray, Carry]:
+        x = obs.astype(jnp.float32) / self.norm_val
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.hidden_dim)(x))
+        if carry is None:
+            carry = self.zero_carry(x.shape[0])
+        carry, x = nn.OptimizedLSTMCell(self.lstm_dim)(carry, x)
+        q = nn.Dense(self.action_space)(x)
+        return q, carry
+
+
+class DrqnCnnModel(nn.Module):
+    """Nature-CNN torso -> LSTM -> Q head: the R2D2 pixel architecture
+    (Nature-DQN convs as in reference core/models/dqn_cnn_model.py:16-30,
+    with the first FC layer's output feeding the LSTM)."""
+
+    action_space: int
+    lstm_dim: int = 512
+    norm_val: float = 255.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    def zero_carry(self, batch: int) -> Carry:
+        z = jnp.zeros((batch, self.lstm_dim), dtype=jnp.float32)
+        return (z, z)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray, carry: Optional[Carry] = None
+                 ) -> Tuple[jnp.ndarray, Carry]:
+        # NCHW uint8 frames -> NHWC for XLA's TPU conv layouts
+        x = obs.astype(self.compute_dtype) / jnp.asarray(
+            self.norm_val, self.compute_dtype)
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        conv = lambda f, k, s: nn.Conv(
+            f, (k, k), strides=(s, s), padding="VALID",
+            dtype=self.compute_dtype)
+        x = nn.relu(conv(32, 8, 4)(x))
+        x = nn.relu(conv(64, 4, 2)(x))
+        x = nn.relu(conv(64, 3, 1)(x))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.lstm_dim, dtype=self.compute_dtype)(x))
+        x = x.astype(jnp.float32)  # LSTM state/gates stay fp32
+        if carry is None:
+            carry = self.zero_carry(x.shape[0])
+        carry, x = nn.OptimizedLSTMCell(self.lstm_dim)(carry, x)
+        q = nn.Dense(self.action_space)(x)
+        return q, carry

@@ -80,6 +80,34 @@ def build_greedy_act(apply_fn: Callable) -> Callable:
     return jax.jit(act)
 
 
+def build_recurrent_epsilon_greedy_act(apply_fn: Callable) -> Callable:
+    """eps-greedy over a recurrent Q-network (models/drqn.py contract
+    ``apply(params, obs, carry) -> (q, carry')``).  Returns a jitted
+    ``act(params, obs[B,...], carry, key, eps) -> (action[B], carry')`` —
+    the caller owns the carry and resets env rows at episode ends."""
+
+    def act(params, obs, carry, key, eps):
+        q, carry = apply_fn(params, obs, carry)
+        batch, num_actions = q.shape
+        greedy = jnp.argmax(q, axis=-1)
+        key_explore, key_choice = jax.random.split(key)
+        random_a = jax.random.randint(key_choice, (batch,), 0, num_actions)
+        explore = jax.random.uniform(key_explore, (batch,)) < eps
+        return jnp.where(explore, random_a, greedy), carry
+
+    return jax.jit(act)
+
+
+def build_recurrent_greedy_act(apply_fn: Callable) -> Callable:
+    """Greedy recurrent variant for evaluator/tester."""
+
+    def act(params, obs, carry):
+        q, carry = apply_fn(params, obs, carry)
+        return jnp.argmax(q, axis=-1), carry
+
+    return jax.jit(act)
+
+
 def build_ddpg_act(actor_apply_fn: Callable) -> Callable:
     """Deterministic policy forward ``act(params, obs[B,...]) -> action[B,d]``
     in [-1,1]; exploration noise (OU) is added host-side by the actor

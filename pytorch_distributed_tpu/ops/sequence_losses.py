@@ -1,0 +1,183 @@
+"""R2D2 sequence update as one pure XLA program.
+
+The recurrent counterpart of ops/losses.py build_dqn_train_step: consumes
+a SegmentBatch (memory/sequence_replay.py), runs
+
+    burn-in unroll (stored state, gradients stopped)
+    -> train-window unroll (online + target nets)
+    -> within-window n-step double-DQN targets with value rescaling
+    -> masked, IS-weighted MSE
+    -> Adam -> target update
+
+all under one jit.  Key R2D2 mechanics (Kapturowski et al. 2019), each a
+flag so ablations stay possible:
+
+- **stored state + burn-in**: the sampled segment carries the actor's LSTM
+  state at its first step; the first ``burn_in`` steps are replayed only
+  to refresh that state under current weights (both online and target
+  nets), no loss on them.
+- **value rescaling**: targets use h(x) = sign(x)(sqrt(|x|+1)-1) + eps*x
+  and its closed-form inverse instead of reward clipping.
+- **sequence priorities**: eta-blended max/mean of per-step |TD| over
+  valid steps, returned as ``td_abs`` for the replay's write-back — the
+  same contract Batch-based steps use, so the learner loop is unchanged.
+
+``lax.scan`` carries the LSTM over time (compiler-friendly control flow —
+no Python loop over T); the n-step lookahead is a static unroll over
+``nstep`` shifted views (nstep is small and static).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from pytorch_distributed_tpu.memory.sequence_replay import SegmentBatch
+from pytorch_distributed_tpu.ops.losses import TrainState
+from pytorch_distributed_tpu.utils.helpers import global_norm, update_target
+
+PyTree = Any
+
+RESCALE_EPS = 1e-3
+
+
+def value_rescale(x: jnp.ndarray, eps: float = RESCALE_EPS) -> jnp.ndarray:
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def value_unrescale(x: jnp.ndarray, eps: float = RESCALE_EPS) -> jnp.ndarray:
+    # closed-form inverse of value_rescale
+    return jnp.sign(x) * (
+        jnp.square((jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps))
+                    - 1.0) / (2.0 * eps)) - 1.0)
+
+
+def unroll(apply_fn: Callable, params: PyTree, carry,
+           obs_tm: jnp.ndarray) -> Tuple[Any, jnp.ndarray]:
+    """Scan the single-step recurrent apply over a time-major observation
+    sequence (T, B, *S) -> (carry_out, q_seq (T, B, A))."""
+
+    def step(c, o):
+        q, c2 = apply_fn(params, o, c)
+        return c2, q
+
+    return jax.lax.scan(step, carry, obs_tm)
+
+
+def build_drqn_train_step(
+    apply_fn: Callable,
+    tx: optax.GradientTransformation,
+    *,
+    burn_in: int = 10,
+    nstep: int = 5,
+    gamma: float = 0.99,
+    enable_double: bool = True,
+    target_model_update: float = 2500,
+    rescale_values: bool = True,
+    priority_eta: float = 0.9,
+    axis_name: str | None = None,
+) -> Callable[[TrainState, SegmentBatch],
+              Tuple[TrainState, Dict[str, jnp.ndarray], jnp.ndarray]]:
+    """Returns ``(state, batch) -> (state, metrics, seq_priorities)``."""
+
+    h = value_rescale if rescale_values else (lambda x: x)
+    h_inv = value_unrescale if rescale_values else (lambda x: x)
+
+    def step(state: TrainState, batch: SegmentBatch):
+        obs_tm = jnp.moveaxis(batch.obs, 0, 1)      # (T+1, B, *S)
+        T = batch.action.shape[1]
+        train_len = T - burn_in
+        carry0 = (batch.c0, batch.h0)
+
+        # target-side state refresh + full unroll (no gradients flow here)
+        tcarry, _ = (unroll(apply_fn, state.target_params, carry0,
+                            obs_tm[:burn_in])
+                     if burn_in else (carry0, None))
+        _, q_target_tm = unroll(apply_fn, state.target_params, tcarry,
+                                obs_tm[burn_in:])   # (train_len+1, B, A)
+
+        # time-major views of the train window
+        a_tm = jnp.moveaxis(batch.action, 0, 1)[burn_in:]        # (L, B)
+        r_tm = jnp.moveaxis(batch.reward, 0, 1)[burn_in:]
+        d_tm = jnp.moveaxis(batch.terminal, 0, 1)[burn_in:]
+        m_tm = jnp.moveaxis(batch.mask, 0, 1)[burn_in:]
+
+        def loss_fn(params):
+            bcarry, _ = (unroll(apply_fn, params, carry0, obs_tm[:burn_in])
+                         if burn_in else (carry0, None))
+            bcarry = jax.lax.stop_gradient(bcarry)
+            _, q_tm = unroll(apply_fn, params, bcarry, obs_tm[burn_in:])
+            q_sel = jnp.take_along_axis(
+                q_tm[:train_len], a_tm[..., None].astype(jnp.int32),
+                axis=-1)[..., 0]                                  # (L, B)
+
+            # bootstrap values at every window position (double-DQN picks
+            # by the online net, evaluates by the target net)
+            if enable_double:
+                a_star = jnp.argmax(q_tm, axis=-1)                # (L+1, B)
+                boot = jnp.take_along_axis(
+                    q_target_tm, a_star[..., None], axis=-1)[..., 0]
+            else:
+                boot = jnp.max(q_target_tm, axis=-1)              # (L+1, B)
+            boot = h_inv(boot)
+
+            # n-step returns inside the window: for each position t,
+            #   G_t = sum_{k<K} gamma^k r_{t+k} * alive_{t,k}
+            #         + gamma^K * alive_{t,K} * boot_{t+K}
+            # with K = min(nstep, n_valid - t, L - t) — the lookahead
+            # shrinks at the window end AND at masked tails (truncated
+            # episodes end their segment without a terminal, so the
+            # bootstrap must come from the last valid position's successor
+            # obs, which SegmentBuilder stores right after the tail) — and
+            # alive_{t,k} = prod_{j<k} (1 - terminal_{t+j}) zeroing the
+            # bootstrap past real deaths.
+            L = train_len
+            pad = lambda x: jnp.concatenate(
+                [x, jnp.zeros((nstep, *x.shape[1:]), x.dtype)], axis=0)
+            r_p, d_p, m_p = pad(r_tm), pad(d_tm), pad(m_tm)
+            ret = jnp.zeros_like(r_tm)
+            alive = jnp.ones_like(r_tm)
+            for k in range(nstep):  # static unroll; nstep is small
+                ret = ret + (gamma ** k) * r_p[k:k + L] * alive \
+                    * m_p[k:k + L]
+                alive = alive * (1.0 - d_p[k:k + L])
+            idx_t = jnp.arange(L)[:, None]                          # (L, 1)
+            n_valid = jnp.sum(m_tm, axis=0).astype(jnp.int32)       # (B,)
+            boot_idx = jnp.minimum(jnp.minimum(idx_t + nstep,
+                                               n_valid[None, :]), L)
+            boot_at = jnp.take_along_axis(
+                boot, boot_idx, axis=0)                             # (L, B)
+            K = jnp.maximum(boot_idx - idx_t, 0).astype(jnp.float32)
+            target = h(ret + (gamma ** K) * alive * boot_at)
+
+            td = q_sel - jax.lax.stop_gradient(target)
+            w = batch.weight[None, :]                             # (1, B)
+            loss = jnp.sum(jnp.square(td) * m_tm * w) / jnp.maximum(
+                jnp.sum(m_tm), 1.0)
+            td_abs = jnp.abs(td) * m_tm
+            valid = jnp.maximum(jnp.sum(m_tm, axis=0), 1.0)       # (B,)
+            seq_pr = (priority_eta * jnp.max(td_abs, axis=0)
+                      + (1 - priority_eta) * jnp.sum(td_abs, axis=0) / valid)
+            return loss, (seq_pr, jnp.mean(jnp.max(q_tm, axis=-1)))
+
+        (loss, (seq_pr, q_mean)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_step = state.step + 1
+        target_params = update_target(state.target_params, params, new_step,
+                                      target_model_update)
+        metrics = {
+            "learner/critic_loss": loss,
+            "learner/q_mean": q_mean,
+            "learner/grad_norm": global_norm(grads),
+        }
+        return (TrainState(params, target_params, opt_state, new_step),
+                metrics, seq_pr)
+
+    return step

@@ -1,0 +1,281 @@
+"""R2D2 sequence family: segment assembly, sequence replay, the recurrent
+unroll, the n-step-in-window targets, and the end-to-end chain topology."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.memory.sequence_replay import (
+    Segment, SegmentBuilder, SequenceReplay,
+)
+
+
+def _carry(v: float, d: int = 4):
+    return (np.full(d, v, np.float32), np.full(d, -v, np.float32))
+
+
+class TestSegmentBuilder:
+    def test_overlapping_emission(self):
+        b = SegmentBuilder(seq_len=4, overlap=2)
+        segs = []
+        for t in range(10):
+            segs += b.push(np.float32([t]), t % 3, float(t), False,
+                           np.float32([t + 1]), _carry(float(t)))
+        # windows [0..3], [2..5], [4..7], [6..9]
+        assert len(segs) == 4
+        s0, s1 = segs[0], segs[1]
+        np.testing.assert_array_equal(s0.obs[:, 0], [0, 1, 2, 3, 4])
+        np.testing.assert_array_equal(s1.obs[:, 0], [2, 3, 4, 5, 6])
+        np.testing.assert_array_equal(s0.action, [0, 1, 2, 0])
+        assert s0.mask.sum() == 4
+        # stored state is the carry BEFORE the segment's first step
+        assert s1.c0[0] == pytest.approx(2.0)
+        assert s1.h0[0] == pytest.approx(-2.0)
+
+    def test_episode_end_pads_and_masks(self):
+        b = SegmentBuilder(seq_len=5, overlap=2)
+        segs = []
+        for t in range(3):
+            segs += b.push(np.float32([t]), 0, 1.0, t == 2,
+                           np.float32([t + 1]), _carry(0.0))
+        assert len(segs) == 1
+        s = segs[0]
+        np.testing.assert_array_equal(s.mask, [1, 1, 1, 0, 0])
+        np.testing.assert_array_equal(s.terminal, [0, 0, 1, 0, 0])
+        # bootstrap obs sits right after the last valid step; pads repeat it
+        assert s.obs[3, 0] == pytest.approx(3.0)
+        assert s.obs[5, 0] == pytest.approx(3.0)
+        # stream reset: the next episode starts a fresh window
+        more = b.push(np.float32([9]), 0, 0.0, False, np.float32([10]),
+                      _carry(9.0))
+        assert more == [] and len(b._steps) == 1
+
+    def test_no_overlap_across_episodes(self):
+        b = SegmentBuilder(seq_len=4, overlap=2)
+        for t in range(4):
+            b.push(np.float32([t]), 0, 0.0, False, np.float32([t + 1]),
+                   _carry(float(t)))
+        segs = b.push(np.float32([4]), 0, 1.0, True, np.float32([5]),
+                      _carry(4.0))
+        # terminal flushes the overlap remainder as its own masked segment
+        assert len(segs) == 1
+        assert b._steps == []
+
+
+class TestSequenceReplay:
+    def _seg(self, v: float, T=4, d=4):
+        return Segment(
+            obs=np.full((T + 1, 1), v, np.float32),
+            action=np.zeros(T, np.int32),
+            reward=np.full(T, v, np.float32),
+            terminal=np.zeros(T, np.float32),
+            mask=np.ones(T, np.float32),
+            c0=np.zeros(d, np.float32), h0=np.zeros(d, np.float32))
+
+    def test_ring_and_uniform_when_alpha_zero(self):
+        mem = SequenceReplay(8, 4, (1,), 4, priority_exponent=0.0)
+        for i in range(10):  # wraps
+            mem.feed(self._seg(float(i)))
+        assert mem.size == 8
+        batch = mem.sample(16, np.random.default_rng(0))
+        assert batch.obs.shape == (16, 5, 1)
+        assert (batch.weight == 1.0).all()
+
+    def test_priorities_bias_sampling(self):
+        mem = SequenceReplay(8, 4, (1,), 4, priority_exponent=1.0)
+        for i in range(8):
+            mem.feed(self._seg(float(i)))
+        mem.update_priorities(np.arange(8), np.r_[np.zeros(7), 100.0])
+        rng = np.random.default_rng(1)
+        batch = mem.sample(256, rng)
+        # row 7 holds ~all priority mass
+        assert (batch.index == 7).mean() > 0.95
+        # IS weights: normalized by the max (min-probability row), so the
+        # oversampled hot row takes the smallest correction weight
+        assert (batch.weight <= 1.0 + 1e-6).all()
+        assert batch.weight[batch.index == 7].max() < 1e-3
+
+
+class TestSequenceLoss:
+    def _apply(self):
+        # linear "recurrent" net: q = W obs + carry passthrough, so targets
+        # are hand-computable; carry = (c, h) each (B, 1)
+        def apply(params, obs, carry=None):
+            q = obs @ params["w"]  # (B, A)
+            if carry is None:
+                carry = (jnp.zeros((obs.shape[0], 1)),) * 2
+            return q, carry
+        return apply
+
+    def test_nstep_window_targets_match_hand_computation(self):
+        from pytorch_distributed_tpu.memory.sequence_replay import (
+            SegmentBatch,
+        )
+        from pytorch_distributed_tpu.ops.sequence_losses import (
+            build_drqn_train_step,
+        )
+        from pytorch_distributed_tpu.ops.losses import (
+            init_train_state,
+        )
+        import optax
+
+        T, nstep, gamma = 4, 2, 0.5
+        apply = self._apply()
+        params = {"w": jnp.eye(1, 3)}  # q(obs)[a] = obs for a=0 else 0
+        tx = optax.sgd(0.0)  # zero lr: inspect td via returned priorities
+        state = init_train_state(params, tx)
+        step = build_drqn_train_step(
+            apply, tx, burn_in=0, nstep=nstep, gamma=gamma,
+            enable_double=False, target_model_update=10 ** 9,
+            rescale_values=False, priority_eta=1.0)
+
+        obs = np.arange(5, dtype=np.float32).reshape(1, 5, 1)  # 0..4
+        batch = SegmentBatch(
+            obs=obs,
+            action=np.zeros((1, T), np.int32),
+            reward=np.array([[1.0, 2.0, 3.0, 4.0]], np.float32),
+            terminal=np.zeros((1, T), np.float32),
+            mask=np.ones((1, T), np.float32),
+            c0=np.zeros((1, 1), np.float32),
+            h0=np.zeros((1, 1), np.float32),
+            weight=np.ones(1, np.float32),
+            index=np.zeros(1, np.int32))
+        _state, _metrics, seq_pr = jax.jit(step)(state, batch)
+        # q_sel[t] = obs[t] = t; boot[s] = max(q(obs[s])) = s
+        # t=0: r0 + g r1 + g^2 * boot(2) = 1 + 1 + 0.5 = 2.5, td = 2.5
+        # t=1: 2 + 1.5 + 0.25*3 = 4.25, td = 3.25
+        # t=2: 3 + 2 + 0.25*4 = 6, td = 4  (boot at 4)
+        # t=3 (window end, K=1): 4 + 0.5*boot(4)=6, td=3
+        # eta=1 -> max |td| = 4
+        assert float(seq_pr[0]) == pytest.approx(4.0, abs=1e-5)
+
+    def test_terminal_cuts_bootstrap(self):
+        from pytorch_distributed_tpu.memory.sequence_replay import (
+            SegmentBatch,
+        )
+        from pytorch_distributed_tpu.ops.sequence_losses import (
+            build_drqn_train_step,
+        )
+        from pytorch_distributed_tpu.ops.losses import init_train_state
+        import optax
+
+        apply = self._apply()
+        params = {"w": jnp.eye(1, 3) * 0.0}  # q == 0 everywhere
+        tx = optax.sgd(0.0)
+        state = init_train_state(params, tx)
+        step = build_drqn_train_step(
+            apply, tx, burn_in=0, nstep=3, gamma=0.5,
+            enable_double=False, target_model_update=10 ** 9,
+            rescale_values=False, priority_eta=1.0)
+        # episode ends at t=1 with reward 10; tail padded
+        batch = SegmentBatch(
+            obs=np.ones((1, 5, 1), np.float32),
+            action=np.zeros((1, 4), np.int32),
+            reward=np.array([[1.0, 10.0, 0.0, 0.0]], np.float32),
+            terminal=np.array([[0.0, 1.0, 0.0, 0.0]], np.float32),
+            mask=np.array([[1.0, 1.0, 0.0, 0.0]], np.float32),
+            c0=np.zeros((1, 1), np.float32),
+            h0=np.zeros((1, 1), np.float32),
+            weight=np.ones(1, np.float32),
+            index=np.zeros(1, np.int32))
+        _state, _m, seq_pr = jax.jit(step)(state, batch)
+        # t=0: G = 1 + 0.5*10 = 6 (no bootstrap past terminal), q=0 -> |td|=6
+        # t=1: G = 10; |td| = 10 -> max
+        assert float(seq_pr[0]) == pytest.approx(10.0, abs=1e-5)
+
+
+class TestTruncationBootstrap:
+    def test_truncated_tail_bootstraps_from_final_obs(self):
+        """A time-limit truncation ends the segment WITHOUT a terminal:
+        targets near the tail must bootstrap from the stored successor
+        observation instead of treating the cut as a death."""
+        from pytorch_distributed_tpu.memory.sequence_replay import (
+            SegmentBatch,
+        )
+        from pytorch_distributed_tpu.ops.sequence_losses import (
+            build_drqn_train_step,
+        )
+        from pytorch_distributed_tpu.ops.losses import init_train_state
+        import optax
+
+        def apply(params, obs, carry=None):
+            q = obs @ params["w"]
+            if carry is None:
+                carry = (jnp.zeros((obs.shape[0], 1)),) * 2
+            return q, carry
+
+        params = {"w": jnp.eye(1, 3)}  # q(obs)[0] = obs
+        tx = optax.sgd(0.0)
+        state = init_train_state(params, tx)
+        step = build_drqn_train_step(
+            apply, tx, burn_in=0, nstep=3, gamma=0.5,
+            enable_double=False, target_model_update=10 ** 9,
+            rescale_values=False, priority_eta=1.0)
+        # 2 valid steps (truncated, NO terminal); bootstrap obs = 7 at
+        # position 2, repeated through the padding
+        obs = np.array([[[1.0], [2.0], [7.0], [7.0], [7.0]]], np.float32)
+        batch = SegmentBatch(
+            obs=obs,
+            action=np.zeros((1, 4), np.int32),
+            reward=np.array([[1.0, 1.0, 0.0, 0.0]], np.float32),
+            terminal=np.zeros((1, 4), np.float32),
+            mask=np.array([[1.0, 1.0, 0.0, 0.0]], np.float32),
+            c0=np.zeros((1, 1), np.float32),
+            h0=np.zeros((1, 1), np.float32),
+            weight=np.ones(1, np.float32),
+            index=np.zeros(1, np.int32))
+        _state, _m, seq_pr = jax.jit(step)(state, batch)
+        # t=0: K=min(3, n_valid-0)=2 -> G = 1 + 0.5*1 + 0.25*boot(7)
+        #      = 1.5 + 1.75 = 3.25; q_sel = 1 -> |td| = 2.25
+        # t=1: K=1 -> G = 1 + 0.5*7 = 4.5; q_sel = 2 -> |td| = 2.5 (max)
+        assert float(seq_pr[0]) == pytest.approx(2.5, abs=1e-5)
+
+
+class TestRecurrentModel:
+    def test_unroll_matches_stepwise(self):
+        from pytorch_distributed_tpu.models.drqn import DrqnMlpModel
+        from pytorch_distributed_tpu.ops.sequence_losses import unroll
+
+        model = DrqnMlpModel(action_space=3, hidden_dim=16, lstm_dim=8)
+        obs = jnp.ones((2, 4))
+        params = model.init(jax.random.PRNGKey(0), obs)
+        seq = jax.random.normal(jax.random.PRNGKey(1), (5, 2, 4))
+        carry = model.zero_carry(2)
+        _, q_seq = unroll(model.apply, params, carry, seq)
+        c = carry
+        for t in range(5):
+            q_t, c = model.apply(params, seq[t], c)
+            np.testing.assert_allclose(np.asarray(q_seq[t]),
+                                       np.asarray(q_t), rtol=1e-5)
+
+    def test_zero_carry_default_matches_explicit(self):
+        from pytorch_distributed_tpu.models.drqn import DrqnMlpModel
+
+        model = DrqnMlpModel(action_space=3, lstm_dim=8)
+        obs = jnp.ones((2, 4))
+        params = model.init(jax.random.PRNGKey(0), obs)
+        q_default, _ = model.apply(params, obs)
+        q_explicit, _ = model.apply(params, obs, model.zero_carry(2))
+        np.testing.assert_allclose(np.asarray(q_default),
+                                   np.asarray(q_explicit))
+
+
+def test_r2d2_chain_topology_learns(tmp_path):
+    from pytorch_distributed_tpu import runtime
+    from pytorch_distributed_tpu.config import build_options
+
+    opt = build_options(
+        13, root_dir=str(tmp_path), num_actors=2, steps=1200, learn_start=8,
+        batch_size=16, memory_size=4096, seq_len=16, seq_overlap=8,
+        burn_in=4, nstep=3, actor_sync_freq=20, param_publish_freq=5,
+        learner_freq=50, evaluator_freq=1, max_replay_ratio=64.0,
+        lr=2e-3, target_model_update=100)
+    runtime.train(opt, backend="thread")
+    opt2 = build_options(13, root_dir=str(tmp_path), mode=2,
+                         tester_nepisodes=5, seq_len=16,
+                         model_file=opt.model_name)
+    out = runtime.test(opt2)
+    assert out["avg_reward"] >= 0.9
+    assert out["avg_steps"] <= 10
