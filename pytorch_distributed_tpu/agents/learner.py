@@ -61,6 +61,11 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     pp = opt.parallel_params
 
     # ---- model + train state (reference dqn_learner.py:21-39) ----
+    # mesh first: sequence-parallel train steps (DTQN ring attention over
+    # the sp axis) are built against it
+    mesh = None
+    if len(jax.devices()) > 1:
+        mesh = make_mesh(pp.dp_size, pp.mp_size, pp.sp_size)
     model = build_model(opt, spec)
     params = init_params(opt, spec, model, seed=opt.seed)
     if opt.model_file:
@@ -68,11 +73,8 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         path = ckpt.params_path(opt.model_file) \
             if not opt.model_file.endswith(".msgpack") else opt.model_file
         params = ckpt.load_params(path, params)
-    state, step_fn = build_train_state_and_step(opt, spec, model, params)
-
-    mesh = None
-    if len(jax.devices()) > 1:
-        mesh = make_mesh(pp.dp_size, pp.mp_size)
+    state, step_fn = build_train_state_and_step(opt, spec, model, params,
+                                                mesh=mesh)
     learner = ShardedLearner(step_fn, mesh, donate=pp.donate)
     state = learner.place(state)
 

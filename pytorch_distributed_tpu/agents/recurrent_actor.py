@@ -52,10 +52,15 @@ class _RecurrentHarness(_ActorHarness):
     # segments replace transitions: override the per-env feed
     def advance(self, actions, next_obs, rewards, terminals, infos,
                 carry_before=None, carry_after=None) -> None:
+        state_for_segment = getattr(self.model, "state_for_segment", None)
         for j in range(self.num_envs):
             true_next = infos[j].get("final_obs", next_obs[j])
             truncated = bool(infos[j].get("truncated", False))
-            per_env_carry = (carry_before[0][j], carry_before[1][j])
+            # stored state for the segment: the LSTM carry row, unless the
+            # model substitutes its own (transformers store a placeholder)
+            per_env_carry = (state_for_segment(carry_before, j)
+                             if state_for_segment is not None
+                             else (carry_before[0][j], carry_before[1][j]))
             for seg in self.builders[j].push(
                     self._obs[j], int(actions[j]), float(rewards[j]),
                     # time-limit truncation ends the segment but must
@@ -109,8 +114,10 @@ def run_r2d2_actor(opt: Options, spec: EnvSpec, process_ind: int,
             a, carry_after = act(h.params, h._obs, carry_before, sub, eps)
             actions = np.asarray(a)
             # np.array (copy): zero-copy views of jax buffers are
-            # read-only, and episode resets write per-env rows in place
-            carry_after = [np.array(c) for c in carry_after]
+            # read-only, and episode resets write per-env rows in place.
+            # Stays a tuple: flipping the carry's pytree container type
+            # would retrace the jitted act on the second tick.
+            carry_after = tuple(np.array(c) for c in carry_after)
         with h.timer.phase("env"):
             next_obs, rewards, terminals, infos = h.env.step(actions)
         with h.timer.phase("advance"):

@@ -51,6 +51,7 @@ CONFIGS = [
     ["dqn",       "pong-sim",  "pong",        "device-per",  "dqn-cnn"], # 12 HBM PER, fully fused
     ["r2d2",      "fake",      "chain",       "sequence",    "drqn-mlp"],# 13 recurrent smoke
     ["r2d2",      "pong-sim",  "pong",        "sequence",    "drqn-cnn"],# 14 R2D2 pixels
+    ["r2d2",      "fake",      "chain",       "sequence",    "dtqn-mlp"],# 15 transformer Q (DTQN)
 ]
 
 
@@ -119,6 +120,10 @@ class ModelParams:
     model_type: str = "dqn-cnn"
     hidden_dim: int = 256              # dqn-mlp width (reference dqn_mlp_model.py:18-26)
     lstm_dim: int = 256                # recurrent core width (drqn-* models)
+    # transformer Q-net (dtqn-*) geometry
+    tf_dim: int = 128
+    tf_heads: int = 4
+    tf_depth: int = 2
     # Apply orthogonal init for the CNN.  The reference *defines* orthogonal
     # init but never applies it (dqn_cnn_model.py:33 commented out) — here it
     # is applied and this flag documents the deliberate divergence.
@@ -238,6 +243,10 @@ class ParallelParams:
     # tensor-sharded heads on wide models.
     dp_size: int = -1                  # -1: all devices on dp
     mp_size: int = 1
+    # sequence/context parallel: shards the time axis of long windows;
+    # ring attention moves K/V around this axis over ICI
+    # (ops/ring_attention.py)
+    sp_size: int = 1
     # Donate learner buffers (params/opt_state) to the jit step.
     donate: bool = True
     # Multi-host: call jax.distributed.initialize (DCN) before device init.
@@ -297,12 +306,15 @@ def parse_set_overrides(pairs) -> dict:
     out = {}
     for kv in pairs:
         k, _, v = kv.partition("=")
-        for cast in (int, float):
-            try:
-                v = cast(v)
-                break
-            except ValueError:
-                continue
+        if v.lower() in ("true", "false"):
+            v = v.lower() == "true"
+        else:
+            for cast in (int, float):
+                try:
+                    v = cast(v)
+                    break
+                except ValueError:
+                    continue
         out[k] = v
     return out
 

@@ -57,7 +57,8 @@ MemoriesDict: Dict[str, Optional[Callable]] = {
 }
 
 # model ctors bound in build_model below (they need probed shapes)
-ModelTypes = ("dqn-cnn", "dqn-mlp", "ddpg-mlp", "drqn-mlp", "drqn-cnn")
+ModelTypes = ("dqn-cnn", "dqn-mlp", "ddpg-mlp", "drqn-mlp", "drqn-cnn",
+              "dtqn-mlp")
 
 
 def _worker_dicts():
@@ -155,8 +156,11 @@ def probe_env(opt: Options) -> EnvSpec:
 # ---------------------------------------------------------------------------
 
 def lstm_dim_of(opt: Options) -> int:
-    """Recurrent core width for the configured model (the CNN variant
-    floors at 512, matching its torso output)."""
+    """Stored-recurrent-state width for the configured model (the CNN
+    variant floors at 512, matching its torso output; transformers store
+    a 1-dim placeholder — their context is the segment window itself)."""
+    if opt.model_type.startswith("dtqn"):
+        return 1
     d = opt.model_params.lstm_dim
     return max(d, 512) if opt.model_type == "drqn-cnn" else d
 
@@ -195,6 +199,20 @@ def build_model(opt: Options, spec: EnvSpec):
                             hidden_dim=mp_.hidden_dim,
                             lstm_dim=mp_.lstm_dim,
                             norm_val=spec.norm_val)
+    if opt.model_type == "dtqn-mlp":
+        from pytorch_distributed_tpu.models.dtqn import DtqnMlpModel
+
+        return DtqnMlpModel(
+            action_space=spec.num_actions,
+            state_shape=spec.state_shape,
+            # the acting window and the learner's T+1-long segments share
+            # one positional table (acting uses leading-aligned windows so
+            # positions match the training distribution exactly)
+            window=opt.agent_params.seq_len + 1,
+            dim=mp_.tf_dim,
+            heads=mp_.tf_heads,
+            depth=mp_.tf_depth,
+            norm_val=spec.norm_val)
     if opt.model_type == "drqn-cnn":
         from pytorch_distributed_tpu.models.drqn import DrqnCnnModel
 
@@ -230,9 +248,12 @@ def ddpg_applies(model) -> Tuple[Callable, Callable]:
 # Train-step builder (the learner's pure XLA program)
 # ---------------------------------------------------------------------------
 
-def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params):
+def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
+                               mesh=None):
     """Returns (TrainState, step_fn) for the configured agent family, wiring
-    optimizers/targets exactly as ops/losses.py documents."""
+    optimizers/targets exactly as ops/losses.py documents.  ``mesh`` (the
+    learner's device mesh) activates sequence-parallel paths: a DTQN model
+    on a mesh with sp > 1 swaps its attention for ring attention."""
     from pytorch_distributed_tpu.ops.losses import (
         build_ddpg_train_step, build_ddpg_train_step_coupled,
         build_dqn_train_step, init_ddpg_train_state, init_train_state,
@@ -246,15 +267,17 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params):
             build_drqn_train_step,
         )
 
-        assert ap.burn_in < ap.seq_len, (
-            f"burn_in={ap.burn_in} must leave a train window inside "
-            f"seq_len={ap.seq_len} (did a --set seq_len override forget "
-            f"burn_in?)")
+        # transformers force burn_in 0 below, so only the LSTM family
+        # needs a train window left after the burn-in prefix
+        assert opt.model_type.startswith("dtqn") \
+            or ap.burn_in < ap.seq_len, (
+                f"burn_in={ap.burn_in} must leave a train window inside "
+                f"seq_len={ap.seq_len} (did a --set seq_len override "
+                f"forget burn_in?)")
         tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay,
                             lr_decay_steps=decay)
         state = init_train_state(params, tx)
-        step = build_drqn_train_step(
-            model.apply, tx,
+        kw = dict(
             burn_in=ap.burn_in,
             nstep=ap.nstep,
             gamma=ap.gamma,
@@ -263,6 +286,34 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params):
             rescale_values=ap.value_rescale,
             priority_eta=ap.priority_eta,
         )
+        if opt.model_type.startswith("dtqn"):
+            from pytorch_distributed_tpu.ops.sequence_losses import (
+                build_dtqn_train_step,
+            )
+
+            # burn-in exists to refresh stale recurrent state; a
+            # transformer has none, so every window position trains
+            # (DTQN trains all timesteps) and acting never lands on a
+            # positional slot without a training signal
+            kw["burn_in"] = 0
+            train_model = model
+            sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+            if sp > 1:
+                # long windows: shard the time axis over sp, attention
+                # rides the ring (same params, same math)
+                from pytorch_distributed_tpu.models.dtqn import (
+                    with_ring_attention,
+                )
+
+                assert (ap.seq_len + 1) % sp == 0, (
+                    f"sequence-parallel DTQN needs window seq_len+1="
+                    f"{ap.seq_len + 1} divisible by mesh sp={sp}")
+                train_model = with_ring_attention(model, mesh)
+            window_apply = lambda p, obs: train_model.apply(
+                p, obs, method=train_model.window_q)
+            step = build_dtqn_train_step(window_apply, tx, **kw)
+        else:
+            step = build_drqn_train_step(model.apply, tx, **kw)
         return state, step
 
     if opt.agent_type == "dqn":

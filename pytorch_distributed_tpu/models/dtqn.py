@@ -1,0 +1,176 @@
+"""Transformer Q-network (DTQN) over observation windows.
+
+The attention-based sibling of models/drqn.py (Esslinger et al. 2022,
+"Deep Transformer Q-Networks for Partially Observable RL"): instead of an
+LSTM carry, the Q-function attends causally over a window of recent
+observations.  This is the model family that exercises the long-context
+machinery — for windows longer than one device can hold, the attention
+call swaps to sequence-parallel ring attention over the mesh's sp axis
+(``attn`` constructor knob; ops/ring_attention.py).
+
+Two call paths, mirroring the DRQN contract so the whole r2d2 pipeline
+(recurrent actor, policies, evaluator, sequence learner) is shared:
+
+- ``window_q(obs_seq)``: one causal pass over a (B, T, *S) window ->
+  (B, T, A) — the learner's path, one transformer call per segment;
+- ``__call__(obs, carry)``: acting path; the carry is a rolling
+  (window, filled) pair, the newest observation is pushed in, and the
+  last position's Q comes out.  Unfilled slots are masked out of
+  attention.  ``state_for_segment`` returns a 1-dim zero placeholder —
+  a transformer needs no stored recurrent state; the segment window
+  itself is the context (burn-in positions act as attention prefix).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.ops.ring_attention import (
+    NEG_INF, full_attention,
+)
+
+Carry = Tuple[jnp.ndarray, jnp.ndarray]  # (window (B,W,*S) f32, filled (B,))
+
+
+class _Block(nn.Module):
+    """Pre-LN transformer block with causal (+padding-masked) attention."""
+
+    dim: int
+    heads: int
+    attn: Optional[Callable] = None  # (q,k,v,causal)->o; None = full
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        B, T, _ = x.shape
+        hdim = self.dim // self.heads
+        y = nn.LayerNorm()(x)
+        qkv = nn.Dense(3 * self.dim)(y).reshape(B, T, 3, self.heads, hdim)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        if pad_mask is not None:
+            # mask padded keys by pushing their scores to -inf: fold the
+            # padding into k's contribution via a bias on scores is not
+            # expressible through the attn interface, so zero the padded
+            # keys and handle their scores with an explicit dense path
+            scale = hdim ** -0.5
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            causal = jnp.tril(jnp.ones((T, T), bool))
+            m = causal[None, None] & pad_mask[:, None, None, :]
+            scores = jnp.where(m, scores, NEG_INF)
+            o = jnp.einsum("bhqk,bhkd->bhqd",
+                           jax.nn.softmax(scores, axis=-1), v)
+        else:
+            o = (self.attn or full_attention)(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, self.dim)
+        x = x + nn.Dense(self.dim)(o)
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(4 * self.dim)(y)
+        x = x + nn.Dense(self.dim)(nn.gelu(y))
+        return x
+
+
+class DtqnMlpModel(nn.Module):
+    """Dense-embed torso -> causal transformer -> Q head (low-dim obs)."""
+
+    action_space: int
+    state_shape: Tuple[int, ...] = ()   # set by the factory from the probe
+    window: int = 32          # acting-path context length
+    dim: int = 128
+    heads: int = 4
+    depth: int = 2
+    norm_val: float = 1.0
+    attn: Optional[Callable] = None  # learner may inject ring attention
+
+    @property
+    def act_window(self) -> int:
+        """Acting context length: one less than the positional table.
+        Training segments span T+1 positions but position T is
+        bootstrap-only (never TD-trained), so acting must keep the newest
+        observation within the trained positions [0, T)."""
+        return self.window - 1
+
+    def zero_carry(self, batch: int) -> Carry:
+        return (jnp.zeros((batch, self.act_window, *self.state_shape),
+                          jnp.float32),
+                jnp.zeros((batch,), jnp.float32))
+
+    def state_for_segment(self, carry: Carry, j: int):
+        """Stored-state placeholder for SegmentBuilder: transformers carry
+        no recurrent state worth replaying from — the segment window
+        itself is the context."""
+        return (np.zeros(1, np.float32), np.zeros(1, np.float32))
+
+    @nn.compact
+    def _encode(self, win: jnp.ndarray,
+                pad_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+        B, T = win.shape[0], win.shape[1]
+        x = win.astype(jnp.float32) / self.norm_val
+        x = x.reshape(B, T, -1)
+        x = nn.Dense(self.dim)(x)
+        x = x + self.param("pos_embed", nn.initializers.normal(0.02),
+                           (self.window, self.dim))[:T]
+        for _ in range(self.depth):
+            x = _Block(self.dim, self.heads, self.attn)(x, pad_mask)
+        x = nn.LayerNorm()(x)
+        # zero-init head: Q starts exactly at 0, so the max-bias of early
+        # bootstrapping has nothing optimistic to amplify — without this
+        # the online loop can drift onto a flat inflated plateau on
+        # sparse-reward envs (tiny TD loss, useless greedy policy)
+        return nn.Dense(self.action_space,
+                        kernel_init=nn.initializers.zeros)(x)  # (B, T, A)
+
+    def __call__(self, obs: jnp.ndarray, carry: Optional[Carry] = None
+                 ) -> Tuple[jnp.ndarray, Carry]:
+        if carry is None:
+            carry = (jnp.zeros(
+                (obs.shape[0], self.act_window, *obs.shape[1:]),
+                jnp.float32),
+                jnp.zeros((obs.shape[0],), jnp.float32))
+        window, filled = carry
+        # LEADING-aligned window: data occupies positions [0, filled) so
+        # acting sees exactly the positional embeddings training windows
+        # are trained on (training segments start at position 0); once
+        # full, the oldest obs rolls off and positions stay [0, W).
+        obs_f = obs.astype(jnp.float32)
+        shifted = jnp.concatenate([window[:, 1:], obs_f[:, None]], axis=1)
+        placed = jax.vmap(
+            lambda w, f, o: jax.lax.dynamic_update_slice_in_dim(
+                w, o[None], f, 0)
+        )(window, filled.astype(jnp.int32), obs_f)
+        full = filled >= float(self.act_window)
+        window = jnp.where(
+            full.reshape(-1, *([1] * (window.ndim - 1))), shifted, placed)
+        filled = jnp.minimum(filled + 1.0, float(self.act_window))
+        slot = jnp.arange(self.act_window)[None, :]
+        pad_mask = slot < filled[:, None]
+        q_seq = self._encode(window, pad_mask)
+        # the newest observation sits at position filled-1
+        last = (filled - 1.0).astype(jnp.int32)
+        q = jnp.take_along_axis(
+            q_seq, last[:, None, None].repeat(q_seq.shape[-1], axis=-1),
+            axis=1)[:, 0]
+        return q, (window, filled)
+
+    def window_q(self, obs_seq: jnp.ndarray) -> jnp.ndarray:
+        """Learner path: causal Q over a fully-valid (B, T, *S) window."""
+        return self._encode(obs_seq, None)
+
+
+def with_ring_attention(model: DtqnMlpModel, mesh) -> DtqnMlpModel:
+    """Clone the model with its attention swapped for sequence-parallel
+    ring attention over ``mesh``'s sp axis — same params, same math (up to
+    fp order); the learner uses this when windows outgrow one device
+    (parallel_params.sp_size > 1)."""
+    import dataclasses
+    import functools
+
+    from pytorch_distributed_tpu.ops.ring_attention import ring_attention
+
+    return dataclasses.replace(
+        model, attn=functools.partial(ring_attention, mesh=mesh,
+                                      axis="sp", batch_axis="dp"))

@@ -1,0 +1,107 @@
+"""Ring attention: sequence-parallel attention over the mesh's sp axis.
+
+No reference equivalent (the reference has no attention at all); this is
+the long-context backbone the TPU framework provides for transformer
+models over long windows (models/dtqn.py): the sequence axis is sharded
+across devices, each device holds one Q/K/V block, and K/V blocks rotate
+around the ring via ``jax.lax.ppermute`` over ICI while every device
+accumulates its Q block's attention with a numerically stable online
+softmax (the blockwise/flash recipe of Liu et al. 2023, "Ring Attention
+with Blockwise Transformers").  Compute of step s overlaps the transfer
+of step s+1's blocks — XLA pipelines the ppermute against the matmuls —
+so the ring hides ICI latency behind MXU work.
+
+Causality across blocks is resolved by carrying each K/V block's global
+offset around the ring with it: a (Tq_local, Tk_local) position mask is
+rebuilt per step from the query shard's offset and the visiting block's
+offset.
+
+``ring_attention`` is the sharded entry point (shard_map over an existing
+mesh); ``full_attention`` is the single-device reference both tests and
+small models use.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   causal: bool = True) -> jnp.ndarray:
+    """Plain softmax attention, (B, H, T, D) in and out — the reference
+    implementation ring_attention must match."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        scores = jnp.where(mask, scores, NEG_INF)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+
+
+def _ring_body(q, k, v, *, axis_name: str, causal: bool, num_blocks: int):
+    """Per-device shard_map body: online-softmax accumulation over the
+    ring of K/V blocks."""
+    scale = q.shape[-1] ** -0.5
+    tq = q.shape[2]
+    tk = k.shape[2]
+    my = jax.lax.axis_index(axis_name)
+    B, H = q.shape[0], q.shape[1]
+
+    q_pos = my * tq + jnp.arange(tq)                     # global q positions
+
+    def step(carry, _):
+        k_blk, v_blk, blk_idx, m, l, o = carry
+        k_pos = blk_idx * tk + jnp.arange(tk)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        s_max = jnp.max(scores, axis=-1)                 # (B, H, tq)
+        m_new = jnp.maximum(m, s_max)
+        # guard: a fully-masked step keeps m at NEG_INF; exp(NEG_INF-
+        # NEG_INF) must not produce NaN
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        # rotate K/V (and their block index) to the next device over ICI
+        perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        idx_next = jax.lax.ppermute(blk_idx, axis_name, perm)
+        return (k_next, v_next, idx_next, m_new, l_new, o_new), None
+
+    init = (
+        k, v, my,
+        jnp.full((B, H, tq), NEG_INF, q.dtype),          # running max
+        jnp.zeros((B, H, tq), q.dtype),                  # normalizer
+        jnp.zeros_like(q),                               # output acc
+    )
+    (_, _, _, m, l, o), _ = jax.lax.scan(step, init, None,
+                                         length=num_blocks)
+    return o / jnp.maximum(l[..., None], 1e-30)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, axis: str = "sp", causal: bool = True,
+                   batch_axis: Optional[str] = "dp") -> jnp.ndarray:
+    """Sequence-parallel attention: (B, H, T, D) with T sharded over
+    ``axis`` (and optionally B over ``batch_axis``).  Matches
+    ``full_attention`` up to fp reduction order."""
+    num_blocks = mesh.shape[axis]
+    bspec = batch_axis if (batch_axis and mesh.shape[batch_axis] > 1) \
+        else None
+    spec = P(bspec, None, axis, None)
+    body = functools.partial(_ring_body, axis_name=axis, causal=causal,
+                             num_blocks=num_blocks)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)

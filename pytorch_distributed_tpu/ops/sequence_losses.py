@@ -67,6 +67,79 @@ def unroll(apply_fn: Callable, params: PyTree, carry,
     return jax.lax.scan(step, carry, obs_tm)
 
 
+def nstep_window_returns(boot: jnp.ndarray, r_tm: jnp.ndarray,
+                         d_tm: jnp.ndarray, m_tm: jnp.ndarray, *,
+                         nstep: int, gamma: float) -> jnp.ndarray:
+    """Within-window n-step returns, shared by the DRQN and DTQN steps.
+
+    For each window position t:
+        G_t = sum_{k<K} gamma^k r_{t+k} * alive_{t,k}
+              + gamma^K * alive_{t,K} * boot_{t+K}
+    with K = min(nstep, n_valid - t, L - t) — the lookahead shrinks at the
+    window end AND at masked tails (truncated episodes end their segment
+    without a terminal, so the bootstrap comes from the last valid
+    position's successor obs, which SegmentBuilder stores right after the
+    tail) — and alive_{t,k} = prod_{j<k} (1 - terminal_{t+j}) zeroing the
+    bootstrap past real deaths.  ``boot`` is (L+1, B) already unrescaled;
+    r/d/m are time-major (L, B).
+    """
+    L = r_tm.shape[0]
+    pad = lambda x: jnp.concatenate(
+        [x, jnp.zeros((nstep, *x.shape[1:]), x.dtype)], axis=0)
+    r_p, d_p, m_p = pad(r_tm), pad(d_tm), pad(m_tm)
+    ret = jnp.zeros_like(r_tm)
+    alive = jnp.ones_like(r_tm)
+    for k in range(nstep):  # static unroll; nstep is small
+        ret = ret + (gamma ** k) * r_p[k:k + L] * alive * m_p[k:k + L]
+        alive = alive * (1.0 - d_p[k:k + L])
+    idx_t = jnp.arange(L)[:, None]                               # (L, 1)
+    n_valid = jnp.sum(m_tm, axis=0).astype(jnp.int32)            # (B,)
+    boot_idx = jnp.minimum(jnp.minimum(idx_t + nstep, n_valid[None, :]), L)
+    boot_at = jnp.take_along_axis(boot, boot_idx, axis=0)        # (L, B)
+    K = jnp.maximum(boot_idx - idx_t, 0).astype(jnp.float32)
+    return ret + (gamma ** K) * alive * boot_at
+
+
+def _masked_loss_and_priority(q_sel, target, m_tm, weight, eta):
+    """IS-weighted masked MSE + eta-blended per-sequence priorities."""
+    td = q_sel - jax.lax.stop_gradient(target)
+    w = weight[None, :]
+    loss = jnp.sum(jnp.square(td) * m_tm * w) / jnp.maximum(
+        jnp.sum(m_tm), 1.0)
+    td_abs = jnp.abs(td) * m_tm
+    valid = jnp.maximum(jnp.sum(m_tm, axis=0), 1.0)
+    seq_pr = (eta * jnp.max(td_abs, axis=0)
+              + (1 - eta) * jnp.sum(td_abs, axis=0) / valid)
+    return loss, seq_pr
+
+
+def _bootstrap_values(q_tm, q_target_tm, enable_double, h_inv):
+    """Per-position bootstrap values (double-DQN optional), unrescaled."""
+    if enable_double:
+        a_star = jnp.argmax(q_tm, axis=-1)
+        boot = jnp.take_along_axis(q_target_tm, a_star[..., None],
+                                   axis=-1)[..., 0]
+    else:
+        boot = jnp.max(q_target_tm, axis=-1)
+    return h_inv(boot)
+
+
+def _apply_update(state, grads, loss, seq_pr, q_mean, tx,
+                  target_model_update):
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    new_step = state.step + 1
+    target_params = update_target(state.target_params, params, new_step,
+                                  target_model_update)
+    metrics = {
+        "learner/critic_loss": loss,
+        "learner/q_mean": q_mean,
+        "learner/grad_norm": global_norm(grads),
+    }
+    return (TrainState(params, target_params, opt_state, new_step),
+            metrics, seq_pr)
+
+
 def build_drqn_train_step(
     apply_fn: Callable,
     tx: optax.GradientTransformation,
@@ -113,71 +186,78 @@ def build_drqn_train_step(
             q_sel = jnp.take_along_axis(
                 q_tm[:train_len], a_tm[..., None].astype(jnp.int32),
                 axis=-1)[..., 0]                                  # (L, B)
-
-            # bootstrap values at every window position (double-DQN picks
-            # by the online net, evaluates by the target net)
-            if enable_double:
-                a_star = jnp.argmax(q_tm, axis=-1)                # (L+1, B)
-                boot = jnp.take_along_axis(
-                    q_target_tm, a_star[..., None], axis=-1)[..., 0]
-            else:
-                boot = jnp.max(q_target_tm, axis=-1)              # (L+1, B)
-            boot = h_inv(boot)
-
-            # n-step returns inside the window: for each position t,
-            #   G_t = sum_{k<K} gamma^k r_{t+k} * alive_{t,k}
-            #         + gamma^K * alive_{t,K} * boot_{t+K}
-            # with K = min(nstep, n_valid - t, L - t) — the lookahead
-            # shrinks at the window end AND at masked tails (truncated
-            # episodes end their segment without a terminal, so the
-            # bootstrap must come from the last valid position's successor
-            # obs, which SegmentBuilder stores right after the tail) — and
-            # alive_{t,k} = prod_{j<k} (1 - terminal_{t+j}) zeroing the
-            # bootstrap past real deaths.
-            L = train_len
-            pad = lambda x: jnp.concatenate(
-                [x, jnp.zeros((nstep, *x.shape[1:]), x.dtype)], axis=0)
-            r_p, d_p, m_p = pad(r_tm), pad(d_tm), pad(m_tm)
-            ret = jnp.zeros_like(r_tm)
-            alive = jnp.ones_like(r_tm)
-            for k in range(nstep):  # static unroll; nstep is small
-                ret = ret + (gamma ** k) * r_p[k:k + L] * alive \
-                    * m_p[k:k + L]
-                alive = alive * (1.0 - d_p[k:k + L])
-            idx_t = jnp.arange(L)[:, None]                          # (L, 1)
-            n_valid = jnp.sum(m_tm, axis=0).astype(jnp.int32)       # (B,)
-            boot_idx = jnp.minimum(jnp.minimum(idx_t + nstep,
-                                               n_valid[None, :]), L)
-            boot_at = jnp.take_along_axis(
-                boot, boot_idx, axis=0)                             # (L, B)
-            K = jnp.maximum(boot_idx - idx_t, 0).astype(jnp.float32)
-            target = h(ret + (gamma ** K) * alive * boot_at)
-
-            td = q_sel - jax.lax.stop_gradient(target)
-            w = batch.weight[None, :]                             # (1, B)
-            loss = jnp.sum(jnp.square(td) * m_tm * w) / jnp.maximum(
-                jnp.sum(m_tm), 1.0)
-            td_abs = jnp.abs(td) * m_tm
-            valid = jnp.maximum(jnp.sum(m_tm, axis=0), 1.0)       # (B,)
-            seq_pr = (priority_eta * jnp.max(td_abs, axis=0)
-                      + (1 - priority_eta) * jnp.sum(td_abs, axis=0) / valid)
+            boot = _bootstrap_values(q_tm, q_target_tm, enable_double,
+                                     h_inv)                       # (L+1, B)
+            target = h(nstep_window_returns(boot, r_tm, d_tm, m_tm,
+                                            nstep=nstep, gamma=gamma))
+            loss, seq_pr = _masked_loss_and_priority(
+                q_sel, target, m_tm, batch.weight, priority_eta)
             return loss, (seq_pr, jnp.mean(jnp.max(q_tm, axis=-1)))
 
         (loss, (seq_pr, q_mean)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
         if axis_name is not None:
             grads = jax.lax.pmean(grads, axis_name)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        new_step = state.step + 1
-        target_params = update_target(state.target_params, params, new_step,
-                                      target_model_update)
-        metrics = {
-            "learner/critic_loss": loss,
-            "learner/q_mean": q_mean,
-            "learner/grad_norm": global_norm(grads),
-        }
-        return (TrainState(params, target_params, opt_state, new_step),
-                metrics, seq_pr)
+        return _apply_update(state, grads, loss, seq_pr, q_mean, tx,
+                             target_model_update)
+
+    return step
+
+
+def build_dtqn_train_step(
+    window_apply: Callable,
+    tx: optax.GradientTransformation,
+    *,
+    burn_in: int = 10,
+    nstep: int = 5,
+    gamma: float = 0.99,
+    enable_double: bool = True,
+    target_model_update: float = 2500,
+    rescale_values: bool = True,
+    priority_eta: float = 0.9,
+    axis_name: str | None = None,
+) -> Callable[[TrainState, SegmentBatch],
+              Tuple[TrainState, Dict[str, jnp.ndarray], jnp.ndarray]]:
+    """Transformer (DTQN) sequence update: same contract as
+    build_drqn_train_step but ONE causal pass per segment instead of a
+    time scan — ``window_apply(params, obs_seq (B,T+1,*S)) -> (B,T+1,A)``
+    (models/dtqn.py window_q).  There is no stored recurrent state: the
+    burn-in prefix participates as attention context only (positions
+    before ``burn_in`` are excluded from the loss)."""
+
+    h = value_rescale if rescale_values else (lambda x: x)
+    h_inv = value_unrescale if rescale_values else (lambda x: x)
+
+    def step(state: TrainState, batch: SegmentBatch):
+        T = batch.action.shape[1]
+        train_len = T - burn_in
+        # (L+1, B, A) over the train window, burn-in kept as context
+        to_tm = lambda q: jnp.moveaxis(q, 0, 1)[burn_in:]
+        q_target_tm = to_tm(window_apply(state.target_params, batch.obs))
+
+        a_tm = jnp.moveaxis(batch.action, 0, 1)[burn_in:]
+        r_tm = jnp.moveaxis(batch.reward, 0, 1)[burn_in:]
+        d_tm = jnp.moveaxis(batch.terminal, 0, 1)[burn_in:]
+        m_tm = jnp.moveaxis(batch.mask, 0, 1)[burn_in:]
+
+        def loss_fn(params):
+            q_tm = to_tm(window_apply(params, batch.obs))
+            q_sel = jnp.take_along_axis(
+                q_tm[:train_len], a_tm[..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            boot = _bootstrap_values(q_tm, q_target_tm, enable_double,
+                                     h_inv)
+            target = h(nstep_window_returns(boot, r_tm, d_tm, m_tm,
+                                            nstep=nstep, gamma=gamma))
+            loss, seq_pr = _masked_loss_and_priority(
+                q_sel, target, m_tm, batch.weight, priority_eta)
+            return loss, (seq_pr, jnp.mean(jnp.max(q_tm, axis=-1)))
+
+        (loss, (seq_pr, q_mean)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+        return _apply_update(state, grads, loss, seq_pr, q_mean, tx,
+                             target_model_update)
 
     return step

@@ -25,26 +25,31 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def make_mesh(dp_size: int = -1, mp_size: int = 1,
+def make_mesh(dp_size: int = -1, mp_size: int = 1, sp_size: int = 1,
               devices=None) -> Mesh:
+    """Logical mesh over the chips: ``dp`` (data parallel), ``sp``
+    (sequence/context parallel — ring attention shards the time axis over
+    it, ops/ring_attention.py) and ``mp`` (tensor parallel)."""
     explicit = devices is not None
     devices = list(devices if explicit else jax.devices())
     n = len(devices)
     if dp_size == -1:
-        assert n % mp_size == 0, f"{n} devices not divisible by mp={mp_size}"
-        dp_size = n // mp_size
-    used = dp_size * mp_size
-    assert used <= n, f"mesh {dp_size}x{mp_size} needs more than {n} devices"
+        assert n % (mp_size * sp_size) == 0, (
+            f"{n} devices not divisible by mp*sp={mp_size * sp_size}")
+        dp_size = n // (mp_size * sp_size)
+    used = dp_size * mp_size * sp_size
+    assert used <= n, (
+        f"mesh {dp_size}x{sp_size}x{mp_size} needs more than {n} devices")
     if used < n and not explicit:
         # an undersized explicit mesh over the default device set silently
         # strands chips — make the throughput loss visible
         import warnings
 
         warnings.warn(
-            f"mesh {dp_size}x{mp_size} uses {used} of {n} available "
+            f"mesh {dp_size}x{sp_size}x{mp_size} uses {used} of {n} available "
             f"devices; {n - used} chip(s) idle", stacklevel=2)
-    grid = np.array(devices[:used]).reshape(dp_size, mp_size)
-    return Mesh(grid, ("dp", "mp"))
+    grid = np.array(devices[:used]).reshape(dp_size, sp_size, mp_size)
+    return Mesh(grid, ("dp", "sp", "mp"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

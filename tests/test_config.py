@@ -68,3 +68,17 @@ def test_selector_overrides_recompute_defaults():
     assert build_options(config=0, memory_type="prioritized").memory_params.enable_per
     # model_type override must re-derive the state dtype family
     assert build_options(config=0, model_type="dqn-mlp").memory_params.state_dtype == "float32"
+
+
+def test_parse_set_overrides_types():
+    from pytorch_distributed_tpu.config import parse_set_overrides
+
+    out = parse_set_overrides([
+        "steps=2000", "lr=2e-3", "game=pong", "value_rescale=false",
+        "enable_double=True",
+    ])
+    assert out["steps"] == 2000 and isinstance(out["steps"], int)
+    assert out["lr"] == 2e-3
+    assert out["game"] == "pong"
+    assert out["value_rescale"] is False
+    assert out["enable_double"] is True

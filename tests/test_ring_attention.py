@@ -1,0 +1,148 @@
+"""Ring attention + DTQN: sequence-parallel attention equals the dense
+reference on the 8-virtual-device CPU mesh, the transformer Q-network
+plugs into it unchanged, and the DTQN family trains end to end."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.ops.ring_attention import (
+    full_attention, ring_attention,
+)
+from pytorch_distributed_tpu.parallel.mesh import make_mesh
+
+
+def _qkv(B=4, H=2, T=32, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(B, H, T, D))
+                             .astype(np.float32)) for _ in range(3))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_full_attention(self, causal):
+        mesh = make_mesh(dp_size=2, sp_size=4)
+        q, k, v = _qkv()
+        out_ring = ring_attention(q, k, v, mesh, causal=causal)
+        out_full = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out_ring),
+                                   np.asarray(out_full),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sp_only_mesh(self):
+        mesh = make_mesh(dp_size=1, sp_size=8)
+        q, k, v = _qkv(B=2, T=64)
+        out_ring = ring_attention(q, k, v, mesh, causal=True)
+        out_full = full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out_ring),
+                                   np.asarray(out_full),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_causality(self):
+        # perturbing future tokens must not change past outputs
+        mesh = make_mesh(dp_size=1, sp_size=8)
+        q, k, v = _qkv(B=2, T=32)
+        out1 = np.asarray(ring_attention(q, k, v, mesh, causal=True))
+        k2 = k.at[:, :, 24:].set(0.0)
+        v2 = v.at[:, :, 24:].set(9.9)
+        out2 = np.asarray(ring_attention(q, k2, v2, mesh, causal=True))
+        np.testing.assert_allclose(out1[:, :, :24], out2[:, :, :24],
+                                   rtol=1e-5)
+        assert np.abs(out1[:, :, 24:] - out2[:, :, 24:]).max() > 1e-3
+
+
+class TestDtqnModel:
+    def _model(self, window=9, attn=None):
+        from pytorch_distributed_tpu.models.dtqn import DtqnMlpModel
+
+        return DtqnMlpModel(action_space=3, state_shape=(4,),
+                            window=window, dim=32, heads=2, depth=1,
+                            attn=attn)
+
+    def test_acting_path_matches_window_path(self):
+        """Stepping obs one by one through the rolling carry must produce
+        the same Q as the learner's one-shot causal window pass."""
+        model = self._model()
+        obs0 = jnp.zeros((2, 4))
+        params = model.init(jax.random.PRNGKey(0), obs0)
+        seq = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 4))
+        q_win = model.apply(params, seq, method=model.window_q)  # (2,6,3)
+        carry = model.zero_carry(2)
+        for t in range(6):
+            q_t, carry = model.apply(params, seq[:, t], carry)
+            np.testing.assert_allclose(np.asarray(q_t),
+                                       np.asarray(q_win[:, t]),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_rolling_window_when_full(self):
+        """Past the acting context (window - 1: the table's last position
+        is bootstrap-only and untrained) the oldest obs falls off; the
+        model output equals a window pass over the last act_window
+        observations."""
+        model = self._model(window=4)
+        A = model.act_window  # 3
+        obs0 = jnp.zeros((1, 4))
+        params = model.init(jax.random.PRNGKey(0), obs0)
+        seq = jax.random.normal(jax.random.PRNGKey(2), (1, 10, 4))
+        carry = model.zero_carry(1)
+        for t in range(10):
+            q_t, carry = model.apply(params, seq[:, t], carry)
+        q_win = model.apply(params, seq[:, -A:], method=model.window_q)
+        np.testing.assert_allclose(np.asarray(q_t),
+                                   np.asarray(q_win[:, -1]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_ring_attention_injection_matches(self):
+        from pytorch_distributed_tpu.models.dtqn import with_ring_attention
+
+        mesh = make_mesh(dp_size=2, sp_size=4)
+        model = self._model(window=16)
+        obs0 = jnp.zeros((2, 4))
+        params = model.init(jax.random.PRNGKey(0), obs0)
+        seq = jax.random.normal(jax.random.PRNGKey(3), (2, 16, 4))
+        q_local = model.apply(params, seq, method=model.window_q)
+        rmodel = with_ring_attention(model, mesh)
+        q_ring = rmodel.apply(params, seq, method=rmodel.window_q)
+        np.testing.assert_allclose(np.asarray(q_ring),
+                                   np.asarray(q_local),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_dtqn_sequence_parallel_learner_runs(tmp_path):
+    """The sp>1 path end to end: a dp2 x sp4 mesh, DTQN's attention swapped
+    for ring attention inside the jitted train step, short topology run."""
+    from pytorch_distributed_tpu import runtime
+    from pytorch_distributed_tpu.config import build_options
+
+    opt = build_options(
+        15, root_dir=str(tmp_path), num_actors=1, steps=40, learn_start=4,
+        batch_size=8, memory_size=1024, seq_len=15, seq_overlap=7,
+        nstep=3, actor_sync_freq=20, param_publish_freq=5, learner_freq=10,
+        evaluator_freq=30, early_stop=60, dp_size=2, sp_size=4)
+    topo = runtime.train(opt, backend="thread")
+    assert topo.clock.learner_step.value >= 40
+
+
+def test_dtqn_chain_topology_learns(tmp_path):
+    from pytorch_distributed_tpu import runtime
+    from pytorch_distributed_tpu.config import build_options
+
+    # config validated over 3 seeds (zero-init Q head + wide exploration
+    # keep the online loop off the flat overestimation plateau)
+    opt = build_options(
+        15, root_dir=str(tmp_path), num_actors=2, steps=1500,
+        learn_start=32, batch_size=16, memory_size=8192, seq_len=16,
+        seq_overlap=8, nstep=3, actor_sync_freq=20, param_publish_freq=5,
+        learner_freq=50, evaluator_freq=2, max_replay_ratio=32.0,
+        lr=1e-3, target_model_update=100, early_stop=200,
+        eps=0.7, eps_alpha=3.0)
+    runtime.train(opt, backend="thread")
+    opt2 = build_options(15, root_dir=str(tmp_path), mode=2,
+                         tester_nepisodes=5, seq_len=16,
+                         model_file=opt.model_name)
+    out = runtime.test(opt2)
+    assert out["nepisodes_solved"] == 5.0
+    assert out["avg_reward"] >= 0.9
+    assert out["avg_steps"] <= 10
