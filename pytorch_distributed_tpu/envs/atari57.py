@@ -1,0 +1,37 @@
+"""The canonical Atari-57 benchmark suite list + sweep helpers.
+
+The reference hardcodes single games in its CONFIGS rows (reference
+utils/options.py:10-14 lists pong/boxing/breakout/enduro); the Ape-X paper
+(and the BASELINE north star's "Atari-57, 256 actors" tracked config)
+evaluates across the 57-game suite.  Game ids here are the ALE rom names
+the Atari env loads (envs/atari.py resolves them through ale_py/atari_py).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+ATARI_57: List[str] = [
+    "alien", "amidar", "assault", "asterix", "asteroids", "atlantis",
+    "bank-heist", "battle-zone", "beam-rider", "berzerk", "bowling",
+    "boxing", "breakout", "centipede", "chopper-command", "crazy-climber",
+    "defender", "demon-attack", "double-dunk", "enduro", "fishing-derby",
+    "freeway", "frostbite", "gopher", "gravitar", "hero", "ice-hockey",
+    "jamesbond", "kangaroo", "krull", "kung-fu-master",
+    "montezuma-revenge", "ms-pacman", "name-this-game", "phoenix",
+    "pitfall", "pong", "private-eye", "qbert", "riverraid", "road-runner",
+    "robotank", "seaquest", "skiing", "solaris", "space-invaders",
+    "star-gunner", "surround", "tennis", "time-pilot", "tutankham",
+    "up-n-down", "venture", "video-pinball", "wizard-of-wor",
+    "yars-revenge", "zaxxon",
+]
+
+assert len(ATARI_57) == 57
+
+
+def resolve_games(spec: str) -> List[str]:
+    """``"all"`` -> the 57-game suite; ``"a,b,c"`` -> that list; a single
+    name -> [name]."""
+    if spec == "all":
+        return list(ATARI_57)
+    return [g.strip() for g in spec.split(",") if g.strip()]

@@ -30,9 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_distributed_tpu.ops.ring_attention import (
-    NEG_INF, full_attention,
-)
+from pytorch_distributed_tpu.ops.ring_attention import full_attention
 
 Carry = Tuple[jnp.ndarray, jnp.ndarray]  # (window (B,W,*S) f32, filled (B,))
 
@@ -53,17 +51,10 @@ class _Block(nn.Module):
         qkv = nn.Dense(3 * self.dim)(y).reshape(B, T, 3, self.heads, hdim)
         q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
         if pad_mask is not None:
-            # mask padded keys by pushing their scores to -inf: fold the
-            # padding into k's contribution via a bias on scores is not
-            # expressible through the attn interface, so zero the padded
-            # keys and handle their scores with an explicit dense path
-            scale = hdim ** -0.5
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-            causal = jnp.tril(jnp.ones((T, T), bool))
-            m = causal[None, None] & pad_mask[:, None, None, :]
-            scores = jnp.where(m, scores, NEG_INF)
-            o = jnp.einsum("bhqk,bhkd->bhqd",
-                           jax.nn.softmax(scores, axis=-1), v)
+            # acting path: unfilled window slots masked out; the injected
+            # attn hook (ring) has no padding concept, but acting windows
+            # always fit one device, so dense attention is the right call
+            o = full_attention(q, k, v, causal=True, key_pad_mask=pad_mask)
         else:
             o = (self.attn or full_attention)(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(B, T, self.dim)

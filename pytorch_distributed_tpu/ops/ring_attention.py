@@ -34,21 +34,30 @@ NEG_INF = -1e30
 
 
 def full_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   causal: bool = True) -> jnp.ndarray:
+                   causal: bool = True,
+                   key_pad_mask: Optional[jnp.ndarray] = None
+                   ) -> jnp.ndarray:
     """Plain softmax attention, (B, H, T, D) in and out — the reference
-    implementation ring_attention must match."""
+    implementation ring_attention must match.  ``key_pad_mask`` (B, Tk)
+    marks valid keys (models/dtqn.py masks unfilled acting-window slots
+    with it)."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    tq, tk = scores.shape[-2], scores.shape[-1]
     if causal:
-        tq, tk = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
         scores = jnp.where(mask, scores, NEG_INF)
+    if key_pad_mask is not None:
+        scores = jnp.where(key_pad_mask[:, None, None, :], scores, NEG_INF)
     return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
 
 
 def _ring_body(q, k, v, *, axis_name: str, causal: bool, num_blocks: int):
     """Per-device shard_map body: online-softmax accumulation over the
-    ring of K/V blocks."""
+    ring of K/V blocks.  The device's own block is folded in before the
+    loop, so the ring rotates exactly num_blocks - 1 times and the visiting
+    block's identity is derived from the step counter (nothing but K/V
+    rides the ring)."""
     scale = q.shape[-1] ** -0.5
     tq = q.shape[2]
     tk = k.shape[2]
@@ -57,8 +66,8 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, num_blocks: int):
 
     q_pos = my * tq + jnp.arange(tq)                     # global q positions
 
-    def step(carry, _):
-        k_blk, v_blk, blk_idx, m, l, o = carry
+    def fold(acc, k_blk, v_blk, blk_idx):
+        m, l, o = acc
         k_pos = blk_idx * tk + jnp.arange(tk)
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
         if causal:
@@ -66,27 +75,42 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, num_blocks: int):
             scores = jnp.where(mask[None, None], scores, NEG_INF)
         s_max = jnp.max(scores, axis=-1)                 # (B, H, tq)
         m_new = jnp.maximum(m, s_max)
-        # guard: a fully-masked step keeps m at NEG_INF; exp(NEG_INF-
-        # NEG_INF) must not produce NaN
         p = jnp.exp(scores - m_new[..., None])
+        # a row that has seen no unmasked key yet has m_new == NEG_INF and
+        # exp(NEG_INF - NEG_INF) == 1 would accumulate garbage V; with the
+        # own (causal-diagonal) block folded first this cannot happen for
+        # equal q/k shards, but guard it rather than rely on the invariant
+        p = jnp.where((m_new == NEG_INF)[..., None], 0.0, p)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
-        # rotate K/V (and their block index) to the next device over ICI
-        perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
-        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        idx_next = jax.lax.ppermute(blk_idx, axis_name, perm)
-        return (k_next, v_next, idx_next, m_new, l_new, o_new), None
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                                 v_blk)
+        return m_new, l_new, o_new
 
-    init = (
-        k, v, my,
+    acc0 = (
         jnp.full((B, H, tq), NEG_INF, q.dtype),          # running max
         jnp.zeros((B, H, tq), q.dtype),                  # normalizer
         jnp.zeros_like(q),                               # output acc
     )
-    (_, _, _, m, l, o), _ = jax.lax.scan(step, init, None,
-                                         length=num_blocks)
+    acc = fold(acc0, k, v, my)                           # own block, step 0
+
+    perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, o = carry
+        # rotate, then fold the block that just arrived (originally from
+        # device (my - s) mod n)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        m, l, o = fold((m, l, o), k_blk, v_blk,
+                       (my - s) % num_blocks)
+        return (k_blk, v_blk, m, l, o), None
+
+    if num_blocks > 1:
+        (_, _, m, l, o), _ = jax.lax.scan(
+            step, (k, v, *acc), jnp.arange(1, num_blocks))
+    else:
+        m, l, o = acc
     return o / jnp.maximum(l[..., None], 1e-30)
 
 

@@ -1,0 +1,113 @@
+"""Benchmark sweep runner: one training run per game, results to JSONL.
+
+The BASELINE tracked configs include "DQN Breakout + Atari-57, 256
+actors"; this is the launcher for that scale.  Each game gets its own
+training run (own refs/checkpoints/logs) followed by a mode-2 test of the
+final checkpoint; one summary line per game appends to
+``{root_dir}/sweep_results.jsonl`` so a partially-completed sweep is
+resumable (finished games are skipped).
+
+    # 2-game smoke on the ALE-free simulator path
+    python -m pytorch_distributed_tpu.sweep --config 4 --games pong \
+        --set steps=2000
+
+    # the full 57-game suite at Ape-X scale, 256 actors per game
+    # (16 actors x 16 envs each; use the fleet CLI to spread hosts)
+    python -m pytorch_distributed_tpu.sweep --config 11 --games all \
+        --num-actors 16 --set num_envs_per_actor=16
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import List, Optional
+
+from pytorch_distributed_tpu.config import (
+    build_options, parse_set_overrides,
+)
+from pytorch_distributed_tpu.envs.atari57 import resolve_games
+
+
+def _results_path(root_dir: str) -> str:
+    return os.path.join(root_dir, "sweep_results.jsonl")
+
+
+def completed_games(root_dir: str) -> set:
+    path = _results_path(root_dir)
+    if not os.path.exists(path):
+        return set()
+    done = set()
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                done.add(json.loads(line)["game"])
+            except (json.JSONDecodeError, KeyError):
+                # a run killed mid-append leaves a torn tail; that game
+                # simply reruns — resume must not abort on it
+                continue
+    return done
+
+
+def run_sweep(config: int, games: List[str], overrides: dict,
+              root_dir: Optional[str] = None,
+              backend: str = "process") -> List[dict]:
+    from pytorch_distributed_tpu import runtime
+
+    root_dir = root_dir or os.getcwd()
+    done = completed_games(root_dir)
+    results = []
+    for game in games:
+        if game in done:
+            print(f"[sweep] {game}: already in results, skipping")
+            continue
+        t0 = time.time()
+        opt = build_options(config, game=game, root_dir=root_dir,
+                            **overrides)
+        print(f"[sweep] {game}: training -> {opt.refs}")
+        runtime.train(opt, backend=backend)
+        test_opt = build_options(config, game=game, root_dir=root_dir,
+                                 mode=2, model_file=opt.model_name,
+                                 **overrides)
+        stats = runtime.test(test_opt)
+        rec = {
+            "game": game,
+            "refs": opt.refs,
+            "wall_s": round(time.time() - t0, 1),
+            **{k: float(v) for k, v in stats.items()},
+        }
+        os.makedirs(root_dir, exist_ok=True)
+        with open(_results_path(root_dir), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        results.append(rec)
+        print(f"[sweep] {game}: {rec}")
+    return results
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="pytorch_distributed_tpu.sweep",
+        description="per-game benchmark sweep (Atari-57 and friends)")
+    ap.add_argument("--config", type=int, required=True)
+    ap.add_argument("--games", type=str, default="all",
+                    help='"all" = Atari-57 suite, or comma-separated names')
+    ap.add_argument("--num-actors", type=int, default=None)
+    ap.add_argument("--root-dir", type=str, default=None)
+    ap.add_argument("--backend", choices=("process", "thread"),
+                    default="process")
+    ap.add_argument("--set", action="append", default=[], metavar="K=V")
+    args = ap.parse_args(argv)
+
+    overrides = parse_set_overrides(args.set)
+    if args.num_actors is not None:
+        overrides["num_actors"] = args.num_actors
+    run_sweep(args.config, resolve_games(args.games), overrides,
+              root_dir=args.root_dir, backend=args.backend)
+
+
+if __name__ == "__main__":
+    main()

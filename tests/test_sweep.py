@@ -1,0 +1,38 @@
+"""Sweep runner + Atari-57 suite list."""
+
+import json
+import os
+
+from pytorch_distributed_tpu.envs.atari57 import ATARI_57, resolve_games
+from pytorch_distributed_tpu.sweep import completed_games, run_sweep
+
+
+def test_suite_list():
+    assert len(ATARI_57) == 57
+    assert len(set(ATARI_57)) == 57
+    assert resolve_games("all") == ATARI_57
+    assert resolve_games("pong,breakout") == ["pong", "breakout"]
+    assert resolve_games("pong") == ["pong"]
+
+
+def test_sweep_runs_and_resumes(tmp_path):
+    overrides = dict(
+        num_actors=1, steps=60, learn_start=16, batch_size=16,
+        memory_size=1024, actor_sync_freq=20, param_publish_freq=5,
+        learner_freq=20, evaluator_freq=30, early_stop=60,
+        tester_nepisodes=1, visualize=False)
+    results = run_sweep(1, ["chain"], overrides, root_dir=str(tmp_path),
+                        backend="thread")
+    assert len(results) == 1
+    assert results[0]["game"] == "chain"
+    assert results[0]["nepisodes"] == 1.0
+    path = os.path.join(str(tmp_path), "sweep_results.jsonl")
+    assert completed_games(str(tmp_path)) == {"chain"}
+    # resumable: the finished game is skipped, file untouched
+    size_before = os.path.getsize(path)
+    again = run_sweep(1, ["chain"], overrides, root_dir=str(tmp_path),
+                      backend="thread")
+    assert again == []
+    assert os.path.getsize(path) == size_before
+    rec = json.loads(open(path).read().strip())
+    assert rec["wall_s"] > 0
