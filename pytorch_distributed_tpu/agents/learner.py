@@ -52,6 +52,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                 param_store: ParamStore, clock: GlobalClock,
                 stats: LearnerStats) -> None:
     import jax
+    import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree
 
     from pytorch_distributed_tpu.parallel.learner import ShardedLearner
@@ -91,6 +92,59 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
 
     _publish(state)
 
+    # Async publication path: the device->host parameter fetch can cost
+    # seconds when the chip sits behind a network tunnel, and it used to
+    # sit INSIDE the learner hot loop.  Now a publish crossing only
+    # enqueues a cheap on-device copy of the param tree (jit outputs
+    # never alias non-donated inputs, so the copy survives later donating
+    # dispatches); a worker thread fetches + publishes in the background,
+    # always taking the freshest snapshot (an in-flight fetch absorbs any
+    # newer requests - actors only ever want the latest version anyway).
+    # TPU only: a concurrent device_get against in-flight multi-device
+    # programs deadlocks the CPU backend's collective rendezvous (see
+    # ShardedLearner.host_params), so the CPU path publishes inline.
+    import threading
+
+    _pub_thread = None
+    if jax.devices()[0].platform == "tpu":
+        _copy_tree = jax.jit(
+            lambda p: jax.tree_util.tree_map(jnp.copy, p))
+        _pub_lock = threading.Lock()
+        _pub_box: list = [None]
+        _pub_event = threading.Event()
+        _pub_stop = threading.Event()
+
+        def _pub_worker() -> None:
+            while True:
+                _pub_event.wait()
+                if _pub_stop.is_set():
+                    return
+                with _pub_lock:
+                    snap, _pub_box[0] = _pub_box[0], None
+                    _pub_event.clear()
+                if snap is None:
+                    continue
+                try:
+                    flat, _ = ravel_pytree(jax.device_get(snap))
+                    param_store.publish(np.asarray(flat, dtype=np.float32))
+                except Exception as e:  # noqa: BLE001 - keep publishing
+                    # a transient fetch error (flaky tunnel) must not
+                    # silently kill publication for the rest of the run —
+                    # actors would act on frozen weights forever
+                    print(f"[learner] async publish failed (will retry "
+                          f"on next snapshot): {e}")
+
+        _pub_thread = threading.Thread(target=_pub_worker,
+                                       name="param-pub", daemon=True)
+        _pub_thread.start()
+
+        def _publish_async(st) -> None:
+            with _pub_lock:
+                _pub_box[0] = _copy_tree(published_params(opt, st))
+                _pub_event.set()
+    else:
+        _publish_async = _publish
+
     is_per = isinstance(memory, QueueOwner)
     is_device_per = isinstance(memory, DevicePerIngest)
     is_device = isinstance(memory, DeviceReplayIngest) and not is_device_per
@@ -98,37 +152,53 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     if on_device:
         # Attach the HBM ring on the learner's mesh and fuse sampling (and
         # for PER: priority write-back) into the train step — one XLA
-        # program per update, no host touch in the hot loop
-        # (memory/device_replay.py, memory/device_per.py docstrings).
+        # program per DISPATCH, which covers ``steps_per_dispatch`` scanned
+        # update steps: launch latency, not chip compute, bounds this loop
+        # on tunnelled/congested setups (memory/device_replay.py
+        # build_uniform_fused_step docstring).
         replay = memory.attach(mesh=mesh)
         beta_dev = None
+        K = ap.steps_per_dispatch
+        if K <= 0:  # auto: amortise dispatch on real accelerators only
+            K = 8 if jax.devices()[0].platform == "tpu" else 1
         if is_device_per:
             fused_per = replay.build_fused_step(step_fn, ap.batch_size,
-                                                donate=pp.donate)
+                                                donate=pp.donate,
+                                                steps_per_call=K)
 
-            def device_step(key):
+            def device_step(keys):
                 nonlocal state
                 state, replay.state, m = fused_per(state, replay.state,
-                                                   key, beta_dev)
+                                                   keys, beta_dev)
                 return m
         else:
             from pytorch_distributed_tpu.memory.device_replay import (
-                sample_rows,
+                build_uniform_fused_step, sample_rows,
             )
 
-            fused = jax.jit(
-                lambda ts, rs, key: step_fn(
-                    ts, sample_rows(rs, key, ap.batch_size)),
-                donate_argnums=(0,) if pp.donate else ())
+            if K > 1:
+                fused = build_uniform_fused_step(
+                    step_fn, ap.batch_size, steps_per_call=K,
+                    donate=pp.donate)
 
-            def device_step(key):
-                nonlocal state
-                state, m, _td = fused(state, replay.state, key)
-                return m
+                def device_step(keys):
+                    nonlocal state
+                    state, m = fused(state, replay.state, keys)
+                    return m
+            else:
+                fused = jax.jit(
+                    lambda ts, rs, key: step_fn(
+                        ts, sample_rows(rs, key, ap.batch_size)),
+                    donate_argnums=(0,) if pp.donate else ())
+
+                def device_step(key):
+                    nonlocal state
+                    state, m, _td = fused(state, replay.state, key)
+                    return m
 
         device_key = jax.random.PRNGKey(
             np_rng(opt.seed, "learner", process_ind).integers(2 ** 31))
-        key_buf: list = []  # pre-split sampling keys, one dispatch per 64
+        key_buf: list = []  # pre-split sampling keys, one split per 64
         # the CPU backend's collective rendezvous needs per-step blocking
         # (see ShardedLearner.step)
         block_each_step = (mesh is not None
@@ -153,6 +223,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     # per-element fetches are round trips that throttle a tunnelled chip)
     last_metrics = None
     t_cadence = time.monotonic()
+    last_stats_lstep = lstep
     timer = StepTimer("learner")
     # per-phase timings go straight to the run's JSONL stream (appends are
     # atomic line writes; the logger process keeps the aggregated scalars)
@@ -179,13 +250,17 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             with timer.phase("drain"):
                 memory.drain()
             if not key_buf:
-                # one split dispatch amortised over 64 steps — a per-step
-                # split is a device round trip that dominates when the
-                # chip sits behind a network tunnel; beta (PER) anneals
-                # slowly and refreshes on the same cadence
-                keys = jax.random.split(device_key, 65)
+                # one split dispatch amortised over 64 dispatches — a
+                # per-step split is a device round trip that dominates
+                # when the chip sits behind a network tunnel; beta (PER)
+                # anneals slowly and refreshes on the same cadence
+                keys = jax.random.split(device_key, 64 * K + 1)
                 device_key = keys[0]
-                key_buf = list(keys[1:])
+                rest = keys[1:]
+                # typed PRNG keys are (n,)-shaped, raw keys (n, 2) —
+                # group into 64 dispatches of K either way
+                key_buf = (list(rest.reshape(64, K, *rest.shape[1:]))
+                           if K > 1 else list(rest))
                 if is_device_per:
                     beta_dev = jax.device_put(
                         np.float32(replay.beta(lstep)))
@@ -205,17 +280,22 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                 with timer.phase("priorities"):
                     memory.update_priorities(np.asarray(batch.index),
                                              np.asarray(td_abs))
-        lstep += 1
+        stride = K if on_device else 1
+        prev = lstep
+        lstep += stride
         clock.set_learner_step(lstep)  # reference dqn_learner.py:94-95
         last_metrics = metrics
 
-        if lstep % ap.param_publish_freq == 0:
+        # cadences fire on boundary crossings so a multi-step dispatch
+        # (stride > 1) never skips them
+        crossed = lambda freq: freq and lstep // freq != prev // freq
+        if crossed(ap.param_publish_freq):
             with timer.phase("publish"):
-                _publish(state)
-        if ap.checkpoint_freq and lstep % ap.checkpoint_freq == 0:
+                _publish_async(state)
+        if crossed(ap.checkpoint_freq):
             ckpt.save_train_state(opt.model_name, state)
 
-        if lstep % ap.learner_freq == 0:  # reference dqn_learner.py:99-101
+        if crossed(ap.learner_freq):  # reference dqn_learner.py:99-101
             now = time.monotonic()
             # sampled (not averaged) losses: the window's last step stands
             # in for the window, one host fetch total
@@ -227,12 +307,18 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                 actor_loss=vals.get("learner/actor_loss", 0.0),
                 q_mean=vals.get("learner/q_mean", 0.0),
                 grad_norm=vals.get("learner/grad_norm", 0.0),
-                steps_per_sec=ap.learner_freq / max(now - t_cadence, 1e-9),
+                steps_per_sec=(lstep - last_stats_lstep)
+                / max(now - t_cadence, 1e-9),
             )
             timing_writer.scalars(timer.drain(), step=lstep)
             t_cadence = now
+            last_stats_lstep = lstep
 
     # final publication + full-state checkpoint so a next run can resume
+    if _pub_thread is not None:
+        _pub_stop.set()
+        _pub_event.set()
+        _pub_thread.join(timeout=120)
     _publish(state)
     ckpt.save_train_state(opt.model_name, state)
     timing_writer.close()

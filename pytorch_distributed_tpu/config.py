@@ -172,6 +172,13 @@ class AgentParams:
     # the pacing knob is first-class here (standard in Ape-X-family
     # systems).
     max_replay_ratio: float = 0.0
+    # Device-replay learners fuse this many update steps into ONE
+    # dispatched XLA program (lax.scan over sample+train): program-launch
+    # latency, not chip compute, bounds the hot loop when dispatch is
+    # high-latency (tunnelled dev chips; congested hosts).  0 = auto
+    # (8 on TPU, 1 elsewhere).  Cadences (publish/checkpoint/stats) are
+    # quantized to the dispatch size.
+    steps_per_dispatch: int = 0
     target_model_update: float = 250   # >=1: hard every N steps; <1: soft tau
     nstep: int = 5
     # --- dqn specifics (reference :138-141) ---

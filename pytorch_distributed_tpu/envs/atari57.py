@@ -11,20 +11,12 @@ from __future__ import annotations
 
 from typing import List
 
-ATARI_57: List[str] = [
-    "alien", "amidar", "assault", "asterix", "asteroids", "atlantis",
-    "bank-heist", "battle-zone", "beam-rider", "berzerk", "bowling",
-    "boxing", "breakout", "centipede", "chopper-command", "crazy-climber",
-    "defender", "demon-attack", "double-dunk", "enduro", "fishing-derby",
-    "freeway", "frostbite", "gopher", "gravitar", "hero", "ice-hockey",
-    "jamesbond", "kangaroo", "krull", "kung-fu-master",
-    "montezuma-revenge", "ms-pacman", "name-this-game", "phoenix",
-    "pitfall", "pong", "private-eye", "qbert", "riverraid", "road-runner",
-    "robotank", "seaquest", "skiing", "solaris", "space-invaders",
-    "star-gunner", "surround", "tennis", "time-pilot", "tutankham",
-    "up-n-down", "venture", "video-pinball", "wizard-of-wor",
-    "yars-revenge", "zaxxon",
-]
+# single source of truth: the suite tuple next to the env that loads the
+# roms (envs/atari.py normalizes "-" to "_" at load, so both id styles
+# resolve to the same games)
+from pytorch_distributed_tpu.envs.atari import ATARI57
+
+ATARI_57: List[str] = list(ATARI57)
 
 assert len(ATARI_57) == 57
 

@@ -161,18 +161,35 @@ class DevicePerReplay(DeviceReplay):
         return self.beta0 + (1.0 - self.beta0) * frac
 
     def build_fused_step(self, train_step, batch_size: int,
-                         donate: bool = True):
+                         donate: bool = True, steps_per_call: int = 1):
+        """Fused sample -> train -> priority write-back; ``steps_per_call``
+        sub-steps scan inside one XLA program (keys then shaped (K, 2)),
+        amortising dispatch latency like
+        device_replay.build_uniform_fused_step — with the priority state
+        chained through the scan so each sub-step samples from the
+        previous one's updated priorities."""
         alpha = self.alpha
-
         draw_fn = self._draw_fn
 
-        def fused(ts, rs: PerReplayState, key, beta):
+        def one(ts, rs: PerReplayState, key, beta):
             batch = per_sample(rs, key, batch_size, beta, sample_fn=draw_fn)
             ts, metrics, td_abs = train_step(ts, batch)
             rs = per_update_priorities(rs, batch.index, td_abs, alpha)
             return ts, rs, metrics
 
-        return jax.jit(fused, donate_argnums=(0, 1) if donate else ())
+        if steps_per_call <= 1:
+            return jax.jit(one, donate_argnums=(0, 1) if donate else ())
+
+        def multi(ts, rs, keys, beta):
+            def body(carry, key):
+                ts, rs = carry
+                ts, rs, metrics = one(ts, rs, key, beta)
+                return (ts, rs), metrics
+
+            (ts, rs), metrics = jax.lax.scan(body, (ts, rs), keys)
+            return ts, rs, jax.tree_util.tree_map(lambda x: x[-1], metrics)
+
+        return jax.jit(multi, donate_argnums=(0, 1) if donate else ())
 
     def sample(self, batch_size: int, key: jax.Array,
                beta: float = 1.0) -> Batch:

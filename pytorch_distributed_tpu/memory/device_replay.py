@@ -98,6 +98,31 @@ def sample_rows(state: ReplayState, key: jax.Array,
     )
 
 
+def build_uniform_fused_step(step_fn, batch_size: int,
+                             steps_per_call: int = 1, donate: bool = True):
+    """One XLA program running ``steps_per_call`` sample+train steps over
+    the HBM ring: ``(train_state, ring_state, keys (K, 2)) ->
+    (train_state', metrics_of_last_substep)``.
+
+    Multi-step fusion exists because program-launch latency, not chip
+    compute, bounds the learner when the device sits behind a network
+    tunnel (or any high-latency dispatch path): K updates per dispatch
+    amortise the launch to 1/K per update.  The ring is read-only inside —
+    ingest stays on the host drain cadence between dispatches.
+    """
+
+    def multi(ts, ring_state, keys):
+        def one(ts, key):
+            ts, metrics, _td = step_fn(ts, sample_rows(ring_state, key,
+                                                       batch_size))
+            return ts, metrics
+
+        ts, metrics = jax.lax.scan(one, ts, keys)
+        return ts, jax.tree_util.tree_map(lambda x: x[-1], metrics)
+
+    return jax.jit(multi, donate_argnums=(0,) if donate else ())
+
+
 class DeviceReplay:
     """Convenience stateful wrapper around the functional ring.
 
@@ -234,8 +259,11 @@ class DeviceReplayIngest:
 
     def close(self) -> None:
         """See QueueOwner.close: reap the queue feeder thread."""
+        # discard rather than flush: leftover experience is garbage at
+        # shutdown, and join_thread would block forever on a full pipe
+        # nobody drains anymore
+        self._q.cancel_join_thread()
         self._q.close()
-        self._q.join_thread()
 
     def drain(self, max_chunks: int = 1024,
               max_rows: int = 32768) -> int:

@@ -102,6 +102,8 @@ class QueueOwner:
     def close(self) -> None:
         """Shut the queue's feeder thread down cleanly — a daemon
         QueueFeederThread left alive at interpreter exit aborts the process
-        from C++ teardown."""
+        from C++ teardown.  Pending items are discarded, not flushed:
+        leftover experience is garbage at shutdown, and joining a feeder
+        blocked on a full pipe nobody drains anymore deadlocks the run."""
+        self._q.cancel_join_thread()
         self._q.close()
-        self._q.join_thread()

@@ -59,6 +59,13 @@ def run_sweep(config: int, games: List[str], overrides: dict,
     from pytorch_distributed_tpu import runtime
 
     root_dir = root_dir or os.getcwd()
+    # the sweep owns these per-run keys; silently duplicating them as
+    # kwargs would TypeError inside build_options
+    for reserved in ("game", "root_dir", "mode", "model_file"):
+        if reserved in overrides:
+            raise ValueError(
+                f"--set {reserved}=... conflicts with sweep-managed "
+                f"options (use the dedicated flags instead)")
     done = completed_games(root_dir)
     results = []
     for game in games:

@@ -92,3 +92,17 @@ def test_fused_step_trains_and_writes_back():
     assert np.isfinite(float(metrics["learner/critic_loss"]))
     # sampled rows got |TD| priorities (almost surely != the initial max)
     assert not np.allclose(np.asarray(rs2.priority), pr_before)
+
+
+def test_multi_step_dispatch_per_topology(tmp_path):
+    from pytorch_distributed_tpu import runtime
+    from pytorch_distributed_tpu.config import build_options
+
+    opt = build_options(
+        1, memory_type="device-per", root_dir=str(tmp_path), num_actors=1,
+        steps=60, learn_start=16, batch_size=16, memory_size=1024,
+        actor_sync_freq=20, param_publish_freq=10, learner_freq=20,
+        evaluator_freq=30, early_stop=60, steps_per_dispatch=4,
+        visualize=False)
+    topo = runtime.train(opt, backend="thread")
+    assert topo.clock.learner_step.value >= 60

@@ -100,3 +100,23 @@ def test_device_ingest_chunks_and_feeds():
     assert len(ing._pending) == 3
     b = ing.replay.sample(8, jax.random.PRNGKey(1))
     assert np.all(np.asarray(b.index) < 4)
+
+
+def test_multi_step_dispatch_topology(tmp_path):
+    """steps_per_dispatch > 1: K scanned updates per dispatched program;
+    clocks/cadences still line up."""
+    from pytorch_distributed_tpu import runtime
+    from pytorch_distributed_tpu.config import build_options
+
+    opt = build_options(
+        1, memory_type="device", root_dir=str(tmp_path), num_actors=1,
+        steps=60, learn_start=16, batch_size=16, memory_size=1024,
+        actor_sync_freq=20, param_publish_freq=10, learner_freq=20,
+        evaluator_freq=30, early_stop=60, steps_per_dispatch=4,
+        visualize=False)
+    topo = runtime.train(opt, backend="thread")
+    assert topo.clock.learner_step.value >= 60
+    from pytorch_distributed_tpu.utils.metrics import read_scalars
+
+    tags = {r["tag"] for r in read_scalars(opt.log_dir)}
+    assert "learner/critic_loss" in tags
