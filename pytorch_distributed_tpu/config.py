@@ -177,7 +177,8 @@ class AgentParams:
     # latency, not chip compute, bounds the hot loop when dispatch is
     # high-latency (tunnelled dev chips; congested hosts).  0 = auto
     # (8 on TPU, 1 elsewhere).  Cadences (publish/checkpoint/stats) are
-    # quantized to the dispatch size.
+    # quantized to the dispatch size, and the ``steps`` budget itself may
+    # overshoot by up to K-1 updates (the final dispatch is whole).
     steps_per_dispatch: int = 0
     target_model_update: float = 250   # >=1: hard every N steps; <1: soft tau
     nstep: int = 5

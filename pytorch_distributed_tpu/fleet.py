@@ -127,15 +127,21 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
 
 
 def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
-                     actor_count: int, backend: str = "process") -> None:
+                     actor_count: int, backend: str = "process",
+                     max_restarts: int = 3) -> None:
     """Run ``actor_count`` rollout workers holding global process_inds
-    ``[actor_base, actor_base + actor_count)``."""
+    ``[actor_base, actor_base + actor_count)``.
+
+    Process backend supervises like the learner host's runtime monitor
+    (runtime.py _monitor): a crashed actor respawns in place — its
+    gateway slot frees when its connection drops, so the replacement
+    re-claims it — up to ``max_restarts`` per slot; clean exits (the run
+    finished) are final."""
     assert actor_base + actor_count <= opt.num_actors, (
         f"actor slots [{actor_base}, {actor_base + actor_count}) exceed "
         f"fleet num_actors={opt.num_actors}")
-    workers: List = []
-    for i in range(actor_count):
-        ind = actor_base + i
+
+    def spawn(ind: int):
         if backend == "process":
             w = _CTX.Process(target=_remote_actor_main,
                              args=(opt, coordinator, ind),
@@ -147,12 +153,52 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
                                  args=(opt, coordinator, ind),
                                  name=f"fleet-actor-{ind}", daemon=True)
         w.start()
-        workers.append(w)
+        return w
+
+    workers = {actor_base + i: spawn(actor_base + i)
+               for i in range(actor_count)}
     print(f"[fleet] actor host up: {actor_count} actors "
           f"(slots {actor_base}..{actor_base + actor_count - 1}) -> "
           f"{coordinator}")
-    for w in workers:
-        w.join()
+    if backend != "process":
+        for w in workers.values():
+            w.join()
+        return
+    restarts: dict = {}
+    born = {ind: time.monotonic() for ind in workers}
+    pending: dict = {}  # slot -> respawn-at deadline (crash backoff)
+    GRACE = 300.0  # an incarnation this old proves the crash was isolated
+    while workers or pending:
+        time.sleep(0.5)
+        now = time.monotonic()
+        for ind, at in list(pending.items()):
+            if now >= at:
+                del pending[ind]
+                workers[ind] = spawn(ind)
+                born[ind] = now
+        for ind, w in list(workers.items()):
+            if w.is_alive():
+                continue
+            if w.exitcode == 0:
+                del workers[ind]  # run complete for this slot
+                continue
+            if now - born.get(ind, 0.0) > GRACE:
+                restarts[ind] = 0  # long-lived incarnation: not a loop
+            if restarts.get(ind, 0) < max_restarts:
+                restarts[ind] = restarts.get(ind, 0) + 1
+                # backoff before respawn: the gateway may still hold the
+                # dead actor's slot until its connection unblocks, and a
+                # hot respawn loop would burn the budget against it
+                delay = min(2.0 * 2 ** (restarts[ind] - 1), 30.0)
+                print(f"[fleet] actor-{ind} died (exit {w.exitcode}); "
+                      f"restart {restarts[ind]}/{max_restarts} "
+                      f"in {delay:.0f}s")
+                del workers[ind]
+                pending[ind] = now + delay
+            else:
+                print(f"[fleet] actor-{ind} out of restart budget; "
+                      f"abandoning slot")
+                del workers[ind]
 
 
 # ---------------------------------------------------------------------------

@@ -146,12 +146,17 @@ class Topology:
         trips the stop event so the run fails fast instead of degrading
         silently."""
         restarts: dict = {}
+        born: dict = {}
+        GRACE = 300.0  # incarnations older than this reset the budget
         while not self.clock.stop.is_set():
             for i, (p, role, ind, args) in enumerate(list(self._proc_meta)):
                 if p.exitcode in (None, 0):
                     continue
+                if time.monotonic() - born.get(ind, 0.0) > GRACE:
+                    restarts[ind] = 0  # isolated crash, not a crash loop
                 if role == "actor" and restarts.get(ind, 0) < max_restarts:
                     restarts[ind] = restarts.get(ind, 0) + 1
+                    born[ind] = time.monotonic()
                     print(f"[runtime] actor-{ind} died "
                           f"(exit {p.exitcode}); restart "
                           f"{restarts[ind]}/{max_restarts}")

@@ -35,6 +35,12 @@ def _results_path(root_dir: str) -> str:
     return os.path.join(root_dir, "sweep_results.jsonl")
 
 
+def _norm(game: str) -> str:
+    """Canonical game id for resume bookkeeping: the rom loader treats
+    hyphenated and underscored ids as the same game, so resume must too."""
+    return game.replace("-", "_")
+
+
 def completed_games(root_dir: str) -> set:
     path = _results_path(root_dir)
     if not os.path.exists(path):
@@ -45,7 +51,7 @@ def completed_games(root_dir: str) -> set:
             if not line.strip():
                 continue
             try:
-                done.add(json.loads(line)["game"])
+                done.add(_norm(json.loads(line)["game"]))
             except (json.JSONDecodeError, KeyError):
                 # a run killed mid-append leaves a torn tail; that game
                 # simply reruns — resume must not abort on it
@@ -69,7 +75,7 @@ def run_sweep(config: int, games: List[str], overrides: dict,
     done = completed_games(root_dir)
     results = []
     for game in games:
-        if game in done:
+        if _norm(game) in done:
             print(f"[sweep] {game}: already in results, skipping")
             continue
         t0 = time.time()
