@@ -22,7 +22,8 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def build_library(name: str, *, flags: Optional[list] = None) -> str:
+def build_library(name: str, *, flags: Optional[list] = None,
+                  timeout: float = 120.0) -> str:
     """Compile native/{name}.cpp -> native/build/lib{name}.so; returns the
     .so path.  Raises NativeBuildError if the toolchain is unusable (callers
     fall back to the pure-Python path)."""
@@ -38,7 +39,7 @@ def build_library(name: str, *, flags: Optional[list] = None) -> str:
            "-o", tmp, src] + (flags or [])
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
+                              timeout=timeout)
     except (OSError, subprocess.TimeoutExpired) as e:  # no g++ / hang
         raise NativeBuildError(f"native build unavailable: {e}") from e
     if proc.returncode != 0:
@@ -48,5 +49,5 @@ def build_library(name: str, *, flags: Optional[list] = None) -> str:
     return so
 
 
-def load_library(name: str) -> ctypes.CDLL:
-    return ctypes.CDLL(build_library(name))
+def load_library(name: str, *, timeout: float = 120.0) -> ctypes.CDLL:
+    return ctypes.CDLL(build_library(name, timeout=timeout))

@@ -1,0 +1,294 @@
+// Batched Pong simulator: N independent games stepped in one C call.
+//
+// First-party native env stepper — the second natural native component
+// SURVEY.md §2 identifies ("a C++ batched env stepper replacing the
+// per-process Python ALE loop"; the reference itself ships zero first-party
+// native code).  Game dynamics, rendering, and the observation pipeline are
+// bit-compatible with the pure-Python simulator in
+// pytorch_distributed_tpu/envs/pong_sim.py: 84x84 grayscale uint8 frames,
+// action-repeat K with a max-pool over the last two raw frames, hist-length
+// frame stack, scoring to 21 (the preprocessing contract of reference
+// core/envs/atari_env.py:53-61,89-104).  Dynamics between scoring events are
+// deterministic doubles, so tests can set identical state on both
+// implementations and require bit-exact frames.
+//
+// Python's round() is round-half-to-even; rendering replicates it (py_round)
+// so frames match the Python simulator exactly.
+//
+// Auto-reset semantics match envs/vector.py: when game i ends, step()
+// returns the *reset* observation for i and deposits the true terminal
+// observation in the final_obs buffer (the n-step assembler must see the
+// real boundary, not the reset frame).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr double H = 84.0, W = 84.0;
+constexpr double PADDLE_H = 10.0, PADDLE_W = 2.0, BALL = 2.0;
+constexpr double PLAYER_X = W - 6.0, ENEMY_X = 4.0;
+constexpr double PLAYER_SPEED = 2.0, ENEMY_SPEED = 0.9;
+constexpr double BALL_SPEED_X = 1.4;
+constexpr int WIN_SCORE = 21;
+constexpr int FRAME = 84 * 84;
+
+// action -> vertical move (NOOP/FIRE/UP/DOWN/UPFIRE/DOWNFIRE)
+const double MOVE[6] = {0.0, 0.0, -PLAYER_SPEED, +PLAYER_SPEED,
+                        -PLAYER_SPEED, +PLAYER_SPEED};
+
+inline double clipd(double v, double lo, double hi) {
+  return v < lo ? lo : (v > hi ? hi : v);
+}
+
+// Python round(): banker's rounding (half to even).
+inline double py_round(double x) {
+  double r = std::nearbyint(x);  // default FP env rounds half-to-even
+  return r == 0.0 ? 0.0 : r;     // normalize -0
+}
+
+// splitmix64 -> uniform doubles; per-env stream.
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed) {}
+  uint64_t next_u64() {
+    uint64_t z = (s += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+  double uniform() {  // [0, 1)
+    return (next_u64() >> 11) * 0x1.0p-53;
+  }
+  double uniform(double lo, double hi) { return lo + (hi - lo) * uniform(); }
+};
+
+struct Game {
+  double player_y, enemy_y, ball_x, ball_y, ball_vx, ball_vy;
+  int score_enemy, score_player;
+  int64_t episode_steps;  // agent steps, for early_stop truncation
+  Rng rng;
+
+  explicit Game(uint64_t seed) : rng(seed) { reset(); }
+
+  void reset_ball(int direction) {
+    ball_x = W / 2;
+    ball_y = rng.uniform(20.0, H - 20.0);
+    ball_vx = BALL_SPEED_X * direction;
+    ball_vy = rng.uniform(-1.2, 1.2);
+  }
+
+  void reset() {
+    score_enemy = score_player = 0;
+    episode_steps = 0;
+    player_y = H / 2;
+    enemy_y = H / 2;
+    int dir = rng.uniform() < 0.5 ? 1 : -1;  // matches pong_sim.py:_reset
+    reset_ball(dir);
+  }
+
+  // one raw frame; returns the player's scoring reward
+  double tick(double move) {
+    player_y = clipd(player_y + move, PADDLE_H / 2, H - PADDLE_H / 2);
+    double err = ball_y - enemy_y;
+    enemy_y = clipd(enemy_y + clipd(err, -ENEMY_SPEED, ENEMY_SPEED),
+                    PADDLE_H / 2, H - PADDLE_H / 2);
+
+    ball_x += ball_vx;
+    ball_y += ball_vy;
+    if (ball_y < BALL / 2) {
+      ball_y = BALL - ball_y;
+      ball_vy = -ball_vy;
+    } else if (ball_y > H - BALL / 2) {
+      ball_y = 2 * (H - BALL / 2) - ball_y;
+      ball_vy = -ball_vy;
+    }
+
+    if (ball_vx > 0 && ball_x >= PLAYER_X - PADDLE_W &&
+        std::fabs(ball_y - player_y) <= PADDLE_H / 2 + BALL / 2) {
+      ball_x = PLAYER_X - PADDLE_W;
+      ball_vx = -ball_vx;
+      ball_vy += 0.5 * (ball_y - player_y) / (PADDLE_H / 2);
+      ball_vy = clipd(ball_vy, -2.0, 2.0);
+    } else if (ball_vx < 0 && ball_x <= ENEMY_X + PADDLE_W &&
+               std::fabs(ball_y - enemy_y) <= PADDLE_H / 2 + BALL / 2) {
+      ball_x = ENEMY_X + PADDLE_W;
+      ball_vx = -ball_vx;
+      ball_vy += 0.5 * (ball_y - enemy_y) / (PADDLE_H / 2);
+      ball_vy = clipd(ball_vy, -2.0, 2.0);
+    }
+
+    if (ball_x < 0) {
+      score_player += 1;
+      reset_ball(-1);
+      return 1.0;
+    }
+    if (ball_x > W) {
+      score_enemy += 1;
+      reset_ball(1);
+      return -1.0;
+    }
+    return 0.0;
+  }
+
+  void draw(uint8_t* f) const {
+    std::memset(f, 35, FRAME);
+    auto vspan = [](double y, int& lo, int& hi) {
+      lo = std::max(0, (int)py_round(y - PADDLE_H / 2));
+      hi = std::min(84, (int)py_round(y + PADDLE_H / 2));
+    };
+    int lo, hi;
+    vspan(enemy_y, lo, hi);
+    for (int r = lo; r < hi; ++r)
+      std::memset(f + r * 84 + (int)(ENEMY_X - PADDLE_W), 130, (size_t)PADDLE_W);
+    vspan(player_y, lo, hi);
+    for (int r = lo; r < hi; ++r)
+      std::memset(f + r * 84 + (int)PLAYER_X, 150, (size_t)PADDLE_W);
+    int by = (int)py_round(ball_y), bx = (int)py_round(ball_x);
+    int r0 = std::max(0, by - 1), r1 = std::min(84, by + 1);
+    int c0 = std::max(0, bx - 1), c1 = std::min(84, bx + 1);
+    for (int r = r0; r < r1; ++r)
+      for (int c = c0; c < c1; ++c) f[r * 84 + c] = 236;
+  }
+};
+
+struct PongBatch {
+  int n, hist, act_rep;
+  int64_t early_stop;  // 0 = disabled
+  std::vector<Game> games;
+  std::vector<uint8_t> stacks;  // n * hist * FRAME, chronological order
+  std::vector<uint8_t> scratch_prev, scratch_cur;
+
+  PongBatch(int n_, int hist_, int act_rep_, int64_t early_stop_,
+            const int64_t* seeds)
+      : n(n_), hist(hist_), act_rep(act_rep_), early_stop(early_stop_) {
+    games.reserve(n);
+    for (int i = 0; i < n; ++i) games.emplace_back((uint64_t)seeds[i]);
+    stacks.assign((size_t)n * hist * FRAME, 0);
+    scratch_prev.resize(FRAME);
+    scratch_cur.resize(FRAME);
+  }
+
+  uint8_t* stack(int i) { return stacks.data() + (size_t)i * hist * FRAME; }
+
+  void fill_stack(int i) {  // reset: stack filled with the first frame
+    uint8_t* s = stack(i);
+    games[i].draw(s);
+    for (int k = 1; k < hist; ++k) std::memcpy(s + k * FRAME, s, FRAME);
+  }
+
+  void push_frame(int i, const uint8_t* frame) {
+    uint8_t* s = stack(i);
+    std::memmove(s, s + FRAME, (size_t)(hist - 1) * FRAME);
+    std::memcpy(s + (size_t)(hist - 1) * FRAME, frame, FRAME);
+  }
+
+  // one agent step of env i; obs/final_obs are hist*FRAME slots
+  void step_one(int i, int action, uint8_t* obs, float* reward,
+                uint8_t* terminal, uint8_t* truncated, uint8_t* final_obs,
+                int32_t* score) {
+    Game& g = games[i];
+    double move = MOVE[((action % 6) + 6) % 6];
+    double rew = 0.0;
+    bool have_prev = act_rep >= 2;
+    for (int k = 0; k < act_rep; ++k) {
+      rew += g.tick(move);
+      if (k == act_rep - 2) g.draw(scratch_prev.data());
+    }
+    g.draw(scratch_cur.data());
+    if (have_prev)
+      for (int p = 0; p < FRAME; ++p)
+        scratch_cur[p] = std::max(scratch_cur[p], scratch_prev[p]);
+    push_frame(i, scratch_cur.data());
+    g.episode_steps += 1;
+
+    bool term = std::max(g.score_enemy, g.score_player) >= WIN_SCORE;
+    // truncation is independent of the game ending this step — the Python
+    // path (envs/base.py step) flags the budget hit unconditionally, and
+    // recurrent actors read it to pick bootstrap-vs-terminal targets
+    bool trunc = early_stop > 0 && g.episode_steps >= early_stop;
+    *reward = (float)rew;
+    *terminal = (uint8_t)(term || trunc);
+    *truncated = (uint8_t)trunc;
+    score[0] = g.score_enemy;
+    score[1] = g.score_player;
+    if (term || trunc) {
+      std::memcpy(final_obs, stack(i), (size_t)hist * FRAME);
+      g.reset();
+      fill_stack(i);
+    }
+    std::memcpy(obs, stack(i), (size_t)hist * FRAME);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+PongBatch* pong_create(int n, int hist, int act_rep, int64_t early_stop,
+                       const int64_t* seeds) {
+  if (n <= 0 || hist <= 0 || act_rep <= 0) return nullptr;
+  return new PongBatch(n, hist, act_rep, early_stop, seeds);
+}
+
+void pong_destroy(PongBatch* pb) { delete pb; }
+
+// obs: (n, hist, 84, 84) uint8
+void pong_reset(PongBatch* pb, uint8_t* obs) {
+  for (int i = 0; i < pb->n; ++i) {
+    pb->games[i].reset();
+    pb->fill_stack(i);
+    std::memcpy(obs + (size_t)i * pb->hist * FRAME, pb->stack(i),
+                (size_t)pb->hist * FRAME);
+  }
+}
+
+// actions: (n,) int32; obs/final_obs: (n, hist, 84, 84) uint8;
+// rewards: (n,) float32; terminals/truncateds: (n,) uint8; scores: (n, 2) int32
+void pong_step(PongBatch* pb, const int32_t* actions, uint8_t* obs,
+               float* rewards, uint8_t* terminals, uint8_t* truncateds,
+               uint8_t* final_obs, int32_t* scores) {
+  for (int i = 0; i < pb->n; ++i)
+    pb->step_one(i, actions[i], obs + (size_t)i * pb->hist * FRAME,
+                 rewards + i, terminals + i, truncateds + i,
+                 final_obs + (size_t)i * pb->hist * FRAME, scores + 2 * i);
+}
+
+// state layout (10 doubles): player_y, enemy_y, ball_x, ball_y, ball_vx,
+// ball_vy, score_enemy, score_player, episode_steps, rng_state — the FULL
+// per-game state, so restore resumes the exact trajectory (truncation clock
+// and the RNG stream included).  rng_state is a uint64 stored through a
+// bit-cast; doubles hold it losslessly.
+int pong_state_size() { return 10; }
+
+void pong_get_state(PongBatch* pb, int i, double* buf) {
+  const Game& g = pb->games[i];
+  buf[0] = g.player_y; buf[1] = g.enemy_y;
+  buf[2] = g.ball_x;   buf[3] = g.ball_y;
+  buf[4] = g.ball_vx;  buf[5] = g.ball_vy;
+  buf[6] = g.score_enemy; buf[7] = g.score_player;
+  buf[8] = (double)g.episode_steps;
+  std::memcpy(&buf[9], &g.rng.s, sizeof(double));
+}
+
+void pong_set_state(PongBatch* pb, int i, const double* buf) {
+  Game& g = pb->games[i];
+  g.player_y = buf[0]; g.enemy_y = buf[1];
+  g.ball_x = buf[2];   g.ball_y = buf[3];
+  g.ball_vx = buf[4];  g.ball_vy = buf[5];
+  g.score_enemy = (int)buf[6]; g.score_player = (int)buf[7];
+  g.episode_steps = (int64_t)buf[8];
+  std::memcpy(&g.rng.s, &buf[9], sizeof(double));
+}
+
+// render env i's CURRENT raw frame (no stack update) — for equivalence tests
+void pong_render(PongBatch* pb, int i, uint8_t* frame) {
+  pb->games[i].draw(frame);
+}
+
+}  // extern "C"

@@ -28,6 +28,10 @@ def run_logger(opt: Options, clock: GlobalClock, actor_stats: ActorStats,
     writer = MetricsWriter(opt.log_dir, enable_tensorboard=opt.visualize)
     last_drain = time.monotonic()
     finished_at = None
+    closing_at = None
+    quiescent = 0
+    final_a: dict = {}
+    final_le: dict = {}
     try:
         while True:
             finished = clock.done(ap.steps)
@@ -38,7 +42,9 @@ def run_logger(opt: Options, clock: GlobalClock, actor_stats: ActorStats,
             closing = finished and (
                 evaluator_stats.done.value
                 or time.monotonic() - finished_at > 60.0)
-            time.sleep(0.2 if not closing else 0.0)
+            if closing and closing_at is None:
+                closing_at = time.monotonic()
+            time.sleep(0.2)
 
             got = evaluator_stats.consume()
             if got is not None:
@@ -50,11 +56,9 @@ def run_logger(opt: Options, clock: GlobalClock, actor_stats: ActorStats,
                     "evaluator/nepisodes_solved": ev["nepisodes_solved"],
                 }, step=at_step)
 
-            if closing or time.monotonic() - last_drain >= ap.logger_freq:
-                last_drain = time.monotonic()
+            def write_group(a: dict, le: dict) -> None:
                 step = clock.learner_step.value
-                a = actor_stats.drain()  # reference dqn_logger.py:34-47
-                if a["nepisodes"] > 0:
+                if a["nepisodes"] > 0:  # reference dqn_logger.py:34-47
                     writer.scalars({
                         "actor/avg_steps": a["total_steps"] / a["nepisodes"],
                         "actor/avg_reward": a["total_reward"] / a["nepisodes"],
@@ -63,8 +67,7 @@ def run_logger(opt: Options, clock: GlobalClock, actor_stats: ActorStats,
                 if a["total_nframes"] > 0:
                     writer.scalar("actor/total_nframes", a["total_nframes"],
                                   step=step)
-                le = learner_stats.drain()  # reference dqn_logger.py:48-55
-                if le["counter"] > 0:
+                if le["counter"] > 0:  # reference dqn_logger.py:48-55
                     writer.scalars({
                         "learner/critic_loss": le["critic_loss"] / le["counter"],
                         "learner/actor_loss": le["actor_loss"] / le["counter"],
@@ -74,7 +77,29 @@ def run_logger(opt: Options, clock: GlobalClock, actor_stats: ActorStats,
                             le["steps_per_sec"] / le["counter"],
                     }, step=step)
                 writer.flush()
+
             if closing:
-                break
+                # shutdown race guard: workers flush their accumulators in
+                # their own shutdown paths, which can land AFTER the run
+                # end is observed here — keep draining until quiescent
+                # (nothing arrived for 2 consecutive drains and a settle
+                # window passed), MERGING the late fragments so the final
+                # datapoint is one aggregate, not several per-fragment
+                # averages at the same step
+                a, le = actor_stats.drain(), learner_stats.drain()
+                arrived = (got is not None or a["nepisodes"] > 0
+                           or a["total_nframes"] > 0 or le["counter"] > 0)
+                for k, v in a.items():
+                    final_a[k] = final_a.get(k, 0.0) + v
+                for k, v in le.items():
+                    final_le[k] = final_le.get(k, 0.0) + v
+                quiescent = 0 if arrived else quiescent + 1
+                if quiescent >= 2 \
+                        and time.monotonic() - closing_at >= 2.0:
+                    write_group(final_a, final_le)
+                    break
+            elif time.monotonic() - last_drain >= ap.logger_freq:
+                last_drain = time.monotonic()
+                write_group(actor_stats.drain(), learner_stats.drain())
     finally:
         writer.close()

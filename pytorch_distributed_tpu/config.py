@@ -85,6 +85,10 @@ class EnvParams:
     # sim envs and batched inference.
     num_envs_per_actor: int = 1
     render: bool = False
+    # Step sim envs through the first-party C++ batched stepper
+    # (native/pong_batch.cpp) when the toolchain builds it; the Python
+    # per-env loop is the fallback either way.
+    native_env: bool = True
 
     @property
     def state_shape(self) -> Tuple[int, ...]:

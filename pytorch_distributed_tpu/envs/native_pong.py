@@ -1,0 +1,150 @@
+"""ctypes wrapper for the C++ batched Pong stepper (native/pong_batch.cpp).
+
+``NativePongVectorEnv`` is a drop-in for ``envs.vector.VectorEnv`` wrapping
+N ``PongSimEnv`` instances: same observation pipeline (84x84 uint8,
+action-repeat + 2-frame maxpool, hist-length stack), same auto-reset
+semantics (reset obs returned, true terminal obs in ``info["final_obs"]``),
+same per-slot seeding (env j of actor i gets slot ``i*N + j``,
+factory.build_env_vector).  One C call steps all N games — the actor hot
+loop (reference dqn_actor.py:84-85; SURVEY.md §3.2) spends its env time in
+native code instead of N Python ``step()`` round-trips.
+
+Falls back at the factory layer: ``build_env_vector`` uses this class only
+when the toolchain builds the library (native/build.py), else the Python
+``VectorEnv``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.envs.base import DiscreteSpace
+
+_lib = None
+
+
+def get_lib() -> ctypes.CDLL:
+    """Build-on-import; raises NativeBuildError when the toolchain is
+    unusable (callers fall back to the Python vector env)."""
+    global _lib
+    if _lib is None:
+        from native.build import load_library
+
+        lib = load_library("pong_batch")
+        lib.pong_create.restype = ctypes.c_void_p
+        lib.pong_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.pong_destroy.argtypes = [ctypes.c_void_p]
+        lib.pong_reset.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.pong_step.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 7
+        lib.pong_state_size.restype = ctypes.c_int
+        lib.pong_get_state.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_double)]
+        lib.pong_set_state.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_double)]
+        lib.pong_render.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class NativePongVectorEnv:
+    """N Pong games stepped as one batch in native code."""
+
+    def __init__(self, env_params, process_ind: int, num_envs: int):
+        self.params = env_params
+        self.num_envs = num_envs
+        self.hist = env_params.state_cha
+        self.norm_val = 255.0
+        self.training = True
+        self._lib = get_lib()
+        seeds = (ctypes.c_int64 * num_envs)(*[
+            env_params.seed + process_ind * num_envs + j
+            for j in range(num_envs)])
+        self._h = self._lib.pong_create(
+            num_envs, self.hist, env_params.action_repetition,
+            env_params.early_stop or 0, seeds)
+        if not self._h:
+            raise RuntimeError("pong_create failed")
+        n, h = num_envs, self.hist
+        self._obs = np.empty((n, h, 84, 84), dtype=np.uint8)
+        self._final = np.empty((n, h, 84, 84), dtype=np.uint8)
+        self._rewards = np.empty(n, dtype=np.float32)
+        self._terminals = np.empty(n, dtype=np.uint8)
+        self._truncateds = np.empty(n, dtype=np.uint8)
+        self._scores = np.empty((n, 2), dtype=np.int32)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.pong_destroy(h)
+            self._h = None
+
+    # -- VectorEnv surface --------------------------------------------------
+
+    def train(self) -> None:
+        self.training = True
+
+    def eval(self) -> None:
+        self.training = False
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        return (self.hist, 84, 84)
+
+    @property
+    def action_space(self) -> DiscreteSpace:
+        return DiscreteSpace(6)
+
+    def reset(self) -> np.ndarray:
+        self._lib.pong_reset(self._h, _ptr(self._obs))
+        return self._obs.copy()
+
+    def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                     List[Dict[str, Any]]]:
+        acts = np.ascontiguousarray(np.asarray(actions, dtype=np.int32))
+        assert acts.shape == (self.num_envs,)
+        assert ((acts >= 0) & (acts < 6)).all(), \
+            f"actions out of range [0, 6): {acts}"
+        self._lib.pong_step(self._h, _ptr(acts), _ptr(self._obs),
+                            _ptr(self._rewards), _ptr(self._terminals),
+                            _ptr(self._truncateds), _ptr(self._final),
+                            _ptr(self._scores))
+        infos: List[Dict[str, Any]] = []
+        for i in range(self.num_envs):
+            info: Dict[str, Any] = {"score": tuple(self._scores[i])}
+            if self._terminals[i]:
+                info["final_obs"] = self._final[i].copy()
+                if self._truncateds[i]:
+                    info["truncated"] = True
+            infos.append(info)
+        return (self._obs.copy(), self._rewards.copy(),
+                self._terminals.astype(bool), infos)
+
+    # -- test / checkpoint hooks --------------------------------------------
+
+    def get_state(self, i: int) -> np.ndarray:
+        buf = (ctypes.c_double * self._lib.pong_state_size())()
+        self._lib.pong_get_state(self._h, i, buf)
+        return np.asarray(buf, dtype=np.float64).copy()
+
+    def set_state(self, i: int, state: np.ndarray) -> None:
+        # a shorter vector (e.g. the 8 dynamics entries) keeps the current
+        # episode clock / RNG stream; the full 10-entry vector restores all
+        cur = self.get_state(i)
+        cur[:len(state)] = np.asarray(state, dtype=np.float64)
+        buf = (ctypes.c_double * len(cur))(*cur)
+        self._lib.pong_set_state(self._h, i, buf)
+
+    def render_frame(self, i: int) -> np.ndarray:
+        frame = np.empty((84, 84), dtype=np.uint8)
+        self._lib.pong_render(self._h, i, _ptr(frame))
+        return frame

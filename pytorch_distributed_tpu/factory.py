@@ -126,15 +126,76 @@ def build_env(opt: Options, process_ind: int = 0):
     return ctor(opt.env_params, process_ind)
 
 
+def _wants_native_pong(opt: Options) -> bool:
+    """One gate for the native pong stepper, shared by the construction
+    path (build_env_vector) and the parent-side prebuild (prebuild_native)
+    so the two can't drift."""
+    return opt.env_type == "pong-sim" and getattr(opt.env_params,
+                                                  "native_env", True)
+
+
 def build_env_vector(opt: Options, process_ind: int, num_envs: int):
     """N env instances as one batched VectorEnv; env j of actor i gets the
     distinct seed slot i*N + j (the reference's per-process scheme,
-    reference atari_env.py:16, extended over the env axis)."""
+    reference atari_env.py:16, extended over the env axis).  For the
+    Pong simulator the whole batch steps in one native C++ call
+    (native/pong_batch.cpp) when the toolchain is available."""
     from pytorch_distributed_tpu.envs.vector import VectorEnv
 
+    if _wants_native_pong(opt):
+        try:
+            from native.build import NativeBuildError
+        except ImportError:  # native/ not shipped alongside the package
+            NativeBuildError = OSError
+        try:
+            from pytorch_distributed_tpu.envs.native_pong import (
+                NativePongVectorEnv,
+            )
+
+            return NativePongVectorEnv(opt.env_params, process_ind, num_envs)
+        except (ImportError, OSError, NativeBuildError) as e:
+            # no native package / toolchain / loadable .so: fall back.
+            # Genuine wrapper bugs raise through — silently degrading a
+            # fleet onto the ~6x-slower Python path is worse than failing.
+            import warnings
+
+            warnings.warn(f"native pong env unavailable ({e}); "
+                          "falling back to Python VectorEnv", stacklevel=2)
     ctor = EnvsDict[opt.env_type]
     return VectorEnv([ctor(opt.env_params, process_ind * num_envs + j)
                       for j in range(num_envs)])
+
+
+def prebuild_native(opt: Options) -> None:
+    """Compile the native .so artifacts ONCE in the supervising parent
+    before workers spawn — N actors racing identical `g++ -O3` builds of
+    the same source is wasted work, and on a congested host some would hit
+    the build timeout and silently drop onto the slower Python fallback.
+    Children then just dlopen the cached library (native/build.py mtime
+    check).  The parent build gets a generous timeout (it is the one that
+    matters) and failures are reported loudly — the run still proceeds,
+    each worker falling back with its own warning through the same
+    gates."""
+    import warnings
+
+    if _wants_native_pong(opt):
+        try:
+            from native.build import build_library
+
+            build_library("pong_batch", timeout=600.0)
+        except Exception as e:  # noqa: BLE001 - degrade with a loud flag
+            warnings.warn(f"parent-side native pong build FAILED ({e}); "
+                          "all workers will run the slower Python env",
+                          stacklevel=2)
+    if opt.memory_type == "native":
+        try:
+            from native.build import build_library
+
+            build_library("ring_buffer", timeout=600.0)
+        except Exception as e:  # noqa: BLE001
+            warnings.warn(f"parent-side native ring build FAILED ({e}); "
+                          "workers fall back to the Python shared replay",
+                          stacklevel=2)
 
 
 def probe_env(opt: Options) -> EnvSpec:

@@ -35,6 +35,7 @@ from __future__ import annotations
 import argparse
 import multiprocessing as mp
 import os
+import sys
 import time
 from typing import List, Optional
 
@@ -128,18 +129,26 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
 
 def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
                      actor_count: int, backend: str = "process",
-                     max_restarts: int = 3) -> None:
+                     max_restarts: int = 3) -> List[int]:
     """Run ``actor_count`` rollout workers holding global process_inds
     ``[actor_base, actor_base + actor_count)``.
 
-    Process backend supervises like the learner host's runtime monitor
-    (runtime.py _monitor): a crashed actor respawns in place — its
-    gateway slot frees when its connection drops, so the replacement
-    re-claims it — up to ``max_restarts`` per slot; clean exits (the run
-    finished) are final."""
+    Process backend supervises with the same RestartBudget policy as the
+    learner host's runtime monitor (utils/supervision.py): a crashed actor
+    respawns in place — its gateway slot frees when its connection drops,
+    so the replacement re-claims it — up to ``max_restarts`` per slot;
+    clean exits (the run finished) are final.  Returns the list of slots
+    abandoned with their budget exhausted (empty = clean host run; the
+    CLI exits nonzero otherwise so an outer orchestrator sees the
+    failure instead of a learner silently training with a reduced
+    fleet)."""
     assert actor_base + actor_count <= opt.num_actors, (
         f"actor slots [{actor_base}, {actor_base + actor_count}) exceed "
         f"fleet num_actors={opt.num_actors}")
+
+    from pytorch_distributed_tpu.factory import prebuild_native
+
+    prebuild_native(opt)  # once, before N workers race the same g++
 
     def spawn(ind: int):
         if backend == "process":
@@ -163,11 +172,15 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
     if backend != "process":
         for w in workers.values():
             w.join()
-        return
-    restarts: dict = {}
-    born = {ind: time.monotonic() for ind in workers}
+        return []
+
+    from pytorch_distributed_tpu.utils.supervision import RestartBudget
+
+    budget = RestartBudget(max_restarts=max_restarts, backoff=True)
+    for ind in workers:
+        budget.note_birth(ind)
     pending: dict = {}  # slot -> respawn-at deadline (crash backoff)
-    GRACE = 300.0  # an incarnation this old proves the crash was isolated
+    abandoned: List[int] = []
     while workers or pending:
         time.sleep(0.5)
         now = time.monotonic()
@@ -175,23 +188,17 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
             if now >= at:
                 del pending[ind]
                 workers[ind] = spawn(ind)
-                born[ind] = now
+                budget.note_birth(ind)
         for ind, w in list(workers.items()):
             if w.is_alive():
                 continue
             if w.exitcode == 0:
                 del workers[ind]  # run complete for this slot
                 continue
-            if now - born.get(ind, 0.0) > GRACE:
-                restarts[ind] = 0  # long-lived incarnation: not a loop
-            if restarts.get(ind, 0) < max_restarts:
-                restarts[ind] = restarts.get(ind, 0) + 1
-                # backoff before respawn: the gateway may still hold the
-                # dead actor's slot until its connection unblocks, and a
-                # hot respawn loop would burn the budget against it
-                delay = min(2.0 * 2 ** (restarts[ind] - 1), 30.0)
+            delay = budget.request_restart(ind)
+            if delay is not None:
                 print(f"[fleet] actor-{ind} died (exit {w.exitcode}); "
-                      f"restart {restarts[ind]}/{max_restarts} "
+                      f"restart {budget.count(ind)}/{max_restarts} "
                       f"in {delay:.0f}s")
                 del workers[ind]
                 pending[ind] = now + delay
@@ -199,6 +206,23 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
                 print(f"[fleet] actor-{ind} out of restart budget; "
                       f"abandoning slot")
                 del workers[ind]
+                abandoned.append(ind)
+        if abandoned:
+            # fail fast like the single-host monitor (runtime._monitor
+            # trips the stop event on the same condition): a host running
+            # a reduced fleet for the rest of a long run is the silent
+            # degradation this supervision exists to prevent.  Terminate
+            # the survivors and surface the failure NOW — the outer
+            # orchestrator restarts the whole host with a fresh budget.
+            for ind, w in list(workers.items()):
+                print(f"[fleet] terminating healthy actor-{ind} "
+                      "(host failing fast)")
+                w.terminate()
+                w.join(10.0)
+            workers.clear()
+            pending.clear()
+            break
+    return abandoned
 
 
 # ---------------------------------------------------------------------------
@@ -243,8 +267,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                           port=args.port)
     else:
         assert args.coordinator, "--coordinator host:port required"
-        run_fleet_actors(opt, args.coordinator, args.actor_base,
-                         args.actor_count)
+        abandoned = run_fleet_actors(opt, args.coordinator, args.actor_base,
+                                     args.actor_count)
+        if abandoned:
+            print(f"[fleet] actor host FAILED: slots {abandoned} out of "
+                  "restart budget")
+            sys.exit(1)
 
 
 if __name__ == "__main__":

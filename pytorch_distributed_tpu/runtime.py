@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional
 
 from pytorch_distributed_tpu.config import Options
 from pytorch_distributed_tpu.factory import (
-    EnvSpec, build_memory, get_worker, probe_env,
+    EnvSpec, build_memory, get_worker, prebuild_native, probe_env,
 )
 from pytorch_distributed_tpu.agents.clocks import (
     ActorStats, EvaluatorStats, GlobalClock, LearnerStats,
@@ -97,6 +97,7 @@ class Topology:
         the learner here, supervise, join."""
         assert backend in ("process", "thread")
         opt = self.opt
+        prebuild_native(opt)  # once, before N workers race the same g++
         if backend == "process":
             self._proc_meta = []
             for role, ind, args in self._worker_specs():
@@ -144,22 +145,21 @@ class Topology:
         contribution just pauses), up to ``max_restarts`` per slot; any
         other abnormal child death — or an actor out of restart budget —
         trips the stop event so the run fails fast instead of degrading
-        silently."""
-        restarts: dict = {}
-        born: dict = {}
-        GRACE = 300.0  # incarnations older than this reset the budget
+        silently.  Restart/GRACE policy shared with the fleet actor-host
+        supervisor via utils/supervision.RestartBudget."""
+        from pytorch_distributed_tpu.utils.supervision import RestartBudget
+
+        budget = RestartBudget(max_restarts=max_restarts)
         while not self.clock.stop.is_set():
             for i, (p, role, ind, args) in enumerate(list(self._proc_meta)):
                 if p.exitcode in (None, 0):
                     continue
-                if time.monotonic() - born.get(ind, 0.0) > GRACE:
-                    restarts[ind] = 0  # isolated crash, not a crash loop
-                if role == "actor" and restarts.get(ind, 0) < max_restarts:
-                    restarts[ind] = restarts.get(ind, 0) + 1
-                    born[ind] = time.monotonic()
+                if role == "actor" \
+                        and budget.request_restart(ind) is not None:
+                    budget.note_birth(ind)
                     print(f"[runtime] actor-{ind} died "
                           f"(exit {p.exitcode}); restart "
-                          f"{restarts[ind]}/{max_restarts}")
+                          f"{budget.count(ind)}/{max_restarts}")
                     self._workers.remove(p)
                     self._proc_meta.remove((p, role, ind, args))
                     self._spawn(role, ind, args)

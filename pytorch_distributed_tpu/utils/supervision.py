@@ -1,0 +1,52 @@
+"""Crash-loop restart policy shared by the worker supervisors.
+
+One policy, two call sites — the single-host runtime monitor
+(runtime.py ``_monitor``) and the multi-host actor-host supervisor
+(fleet.py ``run_fleet_actors``).  The reference has no supervision at all
+(SURVEY.md §5: a dead actor silently reduces throughput, a dead learner
+hangs the run); this is the "failure detection" subsystem it lacked.
+
+Per slot: a restart is granted while fewer than ``max_restarts``
+incarnations have crashed *young*; an incarnation that lived longer than
+``grace`` seconds proves the previous crash was isolated and resets the
+slot's budget, so only genuine crash loops exhaust it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class RestartBudget:
+    """``request_restart(slot)`` returns the respawn delay in seconds —
+    exponential backoff capped at ``max_backoff`` when ``backoff`` is on
+    (a hot respawn loop against a gateway still holding the dead worker's
+    slot would burn the budget), 0.0 otherwise — or None when the slot is
+    out of budget.  Call ``note_birth`` whenever a slot (re)spawns."""
+
+    def __init__(self, max_restarts: int = 3, grace: float = 300.0,
+                 backoff: bool = False, max_backoff: float = 30.0):
+        self.max_restarts = max_restarts
+        self.grace = grace
+        self.backoff = backoff
+        self.max_backoff = max_backoff
+        self._restarts: Dict[int, int] = {}
+        self._born: Dict[int, float] = {}
+
+    def note_birth(self, slot: int) -> None:
+        self._born[slot] = time.monotonic()
+
+    def count(self, slot: int) -> int:
+        return self._restarts.get(slot, 0)
+
+    def request_restart(self, slot: int) -> Optional[float]:
+        if time.monotonic() - self._born.get(slot, 0.0) > self.grace:
+            self._restarts[slot] = 0  # isolated crash, not a crash loop
+        n = self._restarts.get(slot, 0)
+        if n >= self.max_restarts:
+            return None
+        self._restarts[slot] = n + 1
+        if not self.backoff:
+            return 0.0
+        return min(2.0 * 2 ** n, self.max_backoff)

@@ -1,0 +1,176 @@
+"""C++ batched Pong stepper (native/pong_batch.cpp) vs the Python simulator.
+
+Dynamics between scoring events are deterministic doubles in both
+implementations, so the equivalence test sets identical game state on both
+and requires bit-exact frames/rewards step for step.  RNG only enters at
+ball resets (scoring/reset), which the chosen initial state avoids.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import EnvParams
+from pytorch_distributed_tpu.envs.pong_sim import PongSimEnv
+
+try:
+    from pytorch_distributed_tpu.envs.native_pong import (
+        NativePongVectorEnv, get_lib,
+    )
+
+    get_lib()
+    HAVE_NATIVE = True
+except Exception:  # noqa: BLE001 - no toolchain in this image
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(not HAVE_NATIVE,
+                                reason="native toolchain unavailable")
+
+
+def params(**kw) -> EnvParams:
+    base = dict(env_type="pong-sim", seed=7, state_cha=4,
+                early_stop=12500, action_repetition=4)
+    base.update(kw)
+    return EnvParams(**base)
+
+
+def test_shapes_dtypes_and_reset():
+    env = NativePongVectorEnv(params(), process_ind=0, num_envs=3)
+    obs = env.reset()
+    assert obs.shape == (3, 4, 84, 84) and obs.dtype == np.uint8
+    assert env.state_shape == (4, 84, 84)
+    assert env.action_space.n == 6
+    assert env.norm_val == 255.0
+    # reset fills the stack with the first frame
+    for i in range(3):
+        for k in range(1, 4):
+            np.testing.assert_array_equal(obs[i, 0], obs[i, k])
+    # background + two paddles + ball are present
+    vals = set(np.unique(obs[0, 0]).tolist())
+    assert {35, 130, 150, 236} <= vals
+
+
+def test_determinism_and_seed_diversity():
+    a = NativePongVectorEnv(params(), 0, 2)
+    b = NativePongVectorEnv(params(), 0, 2)
+    c = NativePongVectorEnv(params(), 1, 2)  # different seed slots
+    oa, ob, oc = a.reset(), b.reset(), c.reset()
+    np.testing.assert_array_equal(oa, ob)
+    assert not np.array_equal(oa, oc)
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        acts = rng.integers(0, 6, size=2)
+        oa = a.step(acts)[0]
+        ob = b.step(acts)[0]
+        np.testing.assert_array_equal(oa, ob)
+    # the two envs inside one batch evolve independently
+    assert not np.array_equal(oa[0], oa[1])
+
+
+def test_bit_exact_vs_python_sim():
+    """Same state + same actions -> identical frames and rewards."""
+    py = PongSimEnv(params(), process_ind=0)
+    py.reset()
+    nat = NativePongVectorEnv(params(), 0, 1)
+    nat.reset()
+
+    # a mid-court rally state: ball heading to the enemy with spin; no
+    # scoring for the horizon below, so no RNG enters on either side
+    py.player_y, py.enemy_y = 30.0, 55.0
+    py.ball_x, py.ball_y = 42.0, 40.0
+    py.ball_vx, py.ball_vy = -1.4, 0.3
+    py._score = [0, 0]
+    nat.set_state(0, np.array([30.0, 55.0, 42.0, 40.0, -1.4, 0.3, 0, 0]))
+
+    frame_py = py._draw()
+    np.testing.assert_array_equal(frame_py, nat.render_frame(0))
+
+    actions = [2, 3, 0, 5, 4, 1, 2, 2, 3, 0, 1, 4]
+    for t, a in enumerate(actions):
+        obs_py, r_py, term_py, _ = py.step(a)
+        obs_n, r_n, term_n, _ = nat.step([a])
+        assert r_py == 0.0 and r_n[0] == 0.0, "scoring would desync RNG"
+        assert not term_py and not term_n[0]
+        # the newest frame depends only on dynamics; after state_cha steps
+        # the full stacks coincide
+        np.testing.assert_array_equal(obs_py[-1], obs_n[0, -1])
+        if t >= 3:
+            np.testing.assert_array_equal(obs_py, obs_n[0])
+
+
+def test_autoreset_and_truncation():
+    env = NativePongVectorEnv(params(early_stop=3), 0, 2)
+    env.reset()
+    for t in range(3):
+        obs, rew, term, infos = env.step([0, 0])
+    assert term.all()
+    for i in range(2):
+        assert infos[i].get("truncated") is True
+        assert "final_obs" in infos[i]
+        # returned obs is the RESET observation (stack of one frame),
+        # not the terminal one
+        for k in range(1, 4):
+            np.testing.assert_array_equal(obs[i, 0], obs[i, k])
+        assert not np.array_equal(infos[i]["final_obs"], obs[i])
+    # episode counter restarted: next step is not terminal again
+    _, _, term, _ = env.step([0, 0])
+    assert not term.any()
+
+
+def test_game_end_on_truncation_step_still_flags_truncated():
+    """Game point #21 landing exactly on the early_stop step must report
+    truncated=True like the Python path (envs/base.py flags the budget hit
+    unconditionally) — recurrent actors read it for bootstrap-vs-terminal."""
+    env = NativePongVectorEnv(params(early_stop=5), 0, 1)
+    env.reset()
+    for _ in range(4):
+        env.step([0])
+    # 5th step: ball about to cross the enemy goal line, player at 20
+    # points, enemy paddle parked far away -> scoring + win this step
+    env.set_state(0, np.array([42.0, 10.0, 2.0, 70.0, -1.4, 0.0, 0, 20,
+                               4, 0]))
+    _, rew, term, infos = env.step([0])
+    assert rew[0] == 1.0 and term[0]
+    assert infos[0]["score"] == (0, 21)
+    assert infos[0].get("truncated") is True
+
+
+def test_noop_policy_loses_to_tracker():
+    env = NativePongVectorEnv(params(early_stop=0), 0, 1)
+    env.reset()
+    total, done = 0.0, False
+    for _ in range(20000):
+        _, rew, term, infos = env.step([0])
+        total += float(rew[0])
+        if term[0]:
+            done = True
+            break
+    assert done, "NOOP game must reach 21 points"
+    assert total <= -15, f"static paddle should lose badly, got {total}"
+
+
+def test_state_roundtrip():
+    env = NativePongVectorEnv(params(), 0, 1)
+    env.reset()
+    s = env.get_state(0)
+    env.step([3])
+    assert not np.allclose(env.get_state(0), s)
+    env.set_state(0, s)
+    np.testing.assert_allclose(env.get_state(0), s)
+
+
+def test_factory_routes_to_native():
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.factory import build_env_vector
+
+    opt = build_options(config=4)  # pong-sim row
+    opt.env_params.num_envs_per_actor = 2
+    env = build_env_vector(opt, process_ind=0, num_envs=2)
+    assert type(env).__name__ == "NativePongVectorEnv"
+    obs = env.reset()
+    assert obs.shape == (2, opt.env_params.state_cha, 84, 84)
+    # opting out routes back to the Python vector env
+    opt.env_params.native_env = False
+    env = build_env_vector(opt, process_ind=0, num_envs=2)
+    assert type(env).__name__ == "VectorEnv"

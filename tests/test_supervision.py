@@ -1,0 +1,50 @@
+"""RestartBudget — the shared crash-loop policy behind runtime._monitor
+and fleet.run_fleet_actors."""
+
+from __future__ import annotations
+
+from pytorch_distributed_tpu.utils.supervision import RestartBudget
+
+
+def test_budget_exhausts_then_refuses():
+    b = RestartBudget(max_restarts=3, grace=300.0)
+    b.note_birth(0)
+    assert b.request_restart(0) == 0.0
+    assert b.request_restart(0) == 0.0
+    assert b.request_restart(0) == 0.0
+    assert b.request_restart(0) is None
+    assert b.count(0) == 3
+
+
+def test_slots_are_independent():
+    b = RestartBudget(max_restarts=1)
+    b.note_birth(0)
+    b.note_birth(1)
+    assert b.request_restart(0) == 0.0
+    assert b.request_restart(0) is None
+    assert b.request_restart(1) == 0.0
+
+
+def test_old_incarnation_resets_budget():
+    b = RestartBudget(max_restarts=1, grace=0.0)  # every crash is isolated
+    b.note_birth(0)
+    for _ in range(5):
+        assert b.request_restart(0) is not None
+
+
+def test_backoff_grows_and_caps():
+    b = RestartBudget(max_restarts=10, backoff=True, max_backoff=30.0)
+    b.note_birth(0)
+    delays = [b.request_restart(0) for _ in range(6)]
+    assert delays[:4] == [2.0, 4.0, 8.0, 16.0]
+    assert delays[4] == 30.0 and delays[5] == 30.0
+
+
+def test_unborn_slot_starts_fresh():
+    # a slot never marked born reads as an ancient incarnation: the first
+    # crash resets its budget then grants (the runtime monitor starts with
+    # no recorded births and must still restart a crashed actor)
+    b = RestartBudget(max_restarts=1)
+    assert b.request_restart(7) == 0.0
+    b.note_birth(7)  # callers record the respawn; a young crash then burns
+    assert b.request_restart(7) is None
