@@ -44,6 +44,9 @@ from pytorch_distributed_tpu.ops.nstep import NStepAssembler
 from pytorch_distributed_tpu.utils.random_process import (
     OrnsteinUhlenbeckProcess,
 )
+from pytorch_distributed_tpu.utils.helpers import (
+    pin_to_cpu, unravel_on_cpu,
+)
 from pytorch_distributed_tpu.utils.rngs import process_key, process_seed
 
 
@@ -74,7 +77,10 @@ class _ActorHarness:
         # explicit version of the reference's pre-spawn hard sync
         # (reference dqn_actor.py:26-30)
         flat, self.version = param_store.wait(0, stop=clock.stop)
-        self.params = self.unravel(flat)
+        # rollout inference is pinned to the host CPU: the learner owns
+        # the accelerator; batch-1/small-batch forwards must not round-trip
+        # a (possibly tunnelled) chip (utils/helpers.py pin_to_cpu)
+        self.params = unravel_on_cpu(self.unravel, flat)
 
         N = self.num_envs
         self.assemblers: List[NStepAssembler] = [
@@ -169,7 +175,7 @@ class _ActorHarness:
             got = self.param_store.fetch(self.version)
             if got is not None:
                 flat, self.version = got
-                self.params = self.unravel(flat)
+                self.params = unravel_on_cpu(self.unravel, flat)
 
     # -- actor-side TD-error priorities (PER) -------------------------------
 
@@ -244,7 +250,7 @@ def run_dqn_actor(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     act = build_epsilon_greedy_act(h.model.apply)
     eps = apex_epsilons(process_ind, opt.num_actors, h.num_envs,
                         h.ap.eps, h.ap.eps_alpha)
-    key = process_key(opt.seed, "actor", process_ind)
+    key = pin_to_cpu(process_key(opt.seed, "actor", process_ind))
 
     h.start()
     while not clock.done(h.ap.steps):

@@ -25,6 +25,7 @@ from pytorch_distributed_tpu.agents.param_store import (
     ParamStore, make_flattener,
 )
 from pytorch_distributed_tpu.utils import checkpoint as ckpt
+from pytorch_distributed_tpu.utils.helpers import unravel_on_cpu
 from pytorch_distributed_tpu.utils.rngs import process_seed
 
 
@@ -33,6 +34,11 @@ def greedy_episodes(opt: Options, spec: EnvSpec, model, params, env,
     """Run n greedy episodes; returns (avg_steps, avg_reward, solved).
     Greedy = eps 0 for DQN (reference evaluators.py:56-86), noiseless policy
     forward for DDPG, zero-carry recurrent greedy for R2D2."""
+    from pytorch_distributed_tpu.utils.helpers import pin_to_cpu
+
+    # greedy eval is host-side inference: pin params (and any carry) to the
+    # CPU device so batch-1 forwards never round-trip the learner's chip
+    params = pin_to_cpu(params)
     on_reset = lambda: None  # recurrent policies re-bind this per episode
     if opt.agent_type == "dqn":
         from pytorch_distributed_tpu.models.policies import build_greedy_act
@@ -48,14 +54,14 @@ def greedy_episodes(opt: Options, spec: EnvSpec, model, params, env,
         )
 
         ract = build_recurrent_greedy_act(model.apply)
-        carry_box = [model.zero_carry(1)]
+        carry_box = [pin_to_cpu(model.zero_carry(1))]
 
         def pick(obs):
             a, carry_box[0] = ract(params, obs[None], carry_box[0])
             return int(a[0])
 
         def _reset_carry():
-            carry_box[0] = model.zero_carry(1)
+            carry_box[0] = pin_to_cpu(model.zero_carry(1))
         on_reset = _reset_carry
     else:
         from pytorch_distributed_tpu.models.policies import build_ddpg_act
@@ -103,7 +109,9 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         got = param_store.fetch(version)
         if got is not None:
             flat, version = got
-            params = unravel(flat)
+            # host-side inference: unravel straight onto the CPU device
+            # (actors do the same; see utils/helpers.py pin_to_cpu)
+            params = unravel_on_cpu(unravel, flat)
         if params is None:
             return  # learner hasn't published yet
         avg_steps, avg_reward, solved = greedy_episodes(

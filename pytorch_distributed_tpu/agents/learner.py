@@ -204,6 +204,13 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         block_each_step = (mesh is not None
                            and mesh.devices.flat[0].platform == "cpu")
 
+    # warm-start the replay from a prior run's snapshot (after attach, so
+    # device rings land in HBM) — with the train state restored above this
+    # makes resume complete: params/opt/step AND the experience
+    if opt.memory_params.checkpoint_replay:
+        if ckpt.load_replay(opt.model_name, memory):
+            print(f"[learner] replay restored: {memory_size(memory)} rows")
+
     rng = np_rng(opt.seed, "learner", process_ind)
     lstep = int(jax.device_get(state.step))
     lstep0 = lstep  # checkpoint-resumed steps; pacing baselines on THIS run
@@ -321,6 +328,10 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         _pub_thread.join(timeout=120)
     _publish(state)
     ckpt.save_train_state(opt.model_name, state)
+    if opt.memory_params.checkpoint_replay:
+        # final only (replay snapshots are large); the cadence
+        # checkpoints cover the train state
+        ckpt.save_replay(opt.model_name, memory)
     timing_writer.close()
 
 

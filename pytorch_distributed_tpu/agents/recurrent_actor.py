@@ -74,9 +74,10 @@ class _RecurrentHarness(_ActorHarness):
             if terminals[j]:
                 self._record_episode(j, infos[j])
                 # fresh episode: zero carry + fresh segment stream
-                zc = self.model.zero_carry(1)
-                carry_after[0][j] = np.asarray(zc[0])[0]
-                carry_after[1][j] = np.asarray(zc[1])[0]
+                # (plain zeros, not model.zero_carry: a device alloc here
+                # would hit the accelerator once per episode end)
+                carry_after[0][j] = 0.0
+                carry_after[1][j] = 0.0
                 self.builders[j].reset()
         self._obs = next_obs
         self.carry = carry_after
@@ -104,7 +105,9 @@ def run_r2d2_actor(opt: Options, spec: EnvSpec, process_ind: int,
     act = build_recurrent_epsilon_greedy_act(h.model.apply)
     eps = apex_epsilons(process_ind, opt.num_actors, h.num_envs,
                         h.ap.eps, h.ap.eps_alpha)
-    key = process_key(opt.seed, "actor", process_ind)
+    from pytorch_distributed_tpu.utils.helpers import pin_to_cpu
+
+    key = pin_to_cpu(process_key(opt.seed, "actor", process_ind))
 
     h.start()
     while not clock.done(h.ap.steps):

@@ -52,6 +52,7 @@ CONFIGS = [
     ["r2d2",      "fake",      "chain",       "sequence",    "drqn-mlp"],# 13 recurrent smoke
     ["r2d2",      "pong-sim",  "pong",        "sequence",    "drqn-cnn"],# 14 R2D2 pixels
     ["r2d2",      "fake",      "chain",       "sequence",    "dtqn-mlp"],# 15 transformer Q (DTQN)
+    ["ddpg",      "classic",   "reacher",     "shared",      "ddpg-mlp"],# 16 multi-dim continuous control
 ]
 
 
@@ -110,6 +111,10 @@ class MemoryParams:
     # PER exponents (reference utils/options.py:92-94; Ape-X paper values).
     priority_exponent: float = 0.6
     priority_weight: float = 0.4
+    # Save/restore replay CONTENTS with the train-state checkpoint (the
+    # resume leg the reference lacks, SURVEY.md §5).  Off by default:
+    # image replays serialize to large files; written once at run end.
+    checkpoint_replay: bool = False
     # NOTE: device-resident (HBM) replay is selected via
     # ``memory_type="device"`` (CONFIGS row 8), not a flag here: the buffer
     # is sharded across the learner mesh's dp axis and sampled on device

@@ -71,12 +71,16 @@ class CartPoleEnv(Env):
         theta_dot = theta_dot + self.tau * thetaacc
         self.state = np.array([x, x_dot, theta, theta_dot])
         self._steps += 1
-        terminal = bool(
-            abs(x) > self.x_threshold
-            or abs(theta) > self.theta_threshold
-            or self._steps >= self.max_steps
-        )
-        return self.state.astype(np.float32), 1.0, terminal, {}
+        died = bool(abs(x) > self.x_threshold
+                    or abs(theta) > self.theta_threshold)
+        timed_out = self._steps >= self.max_steps
+        info: Dict[str, Any] = {}
+        if timed_out and not died:
+            # surviving to the step cap is a truncation (bootstrap), not
+            # a failure terminal
+            info["truncated"] = True
+        return (self.state.astype(np.float32), 1.0, died or timed_out,
+                info)
 
 
 class PendulumEnv(Env):
@@ -130,7 +134,94 @@ class PendulumEnv(Env):
         self.state = np.array([th, thdot])
         self._steps += 1
         terminal = self._steps >= self.max_steps
-        return self._obs(), float(-cost), terminal, {}
+        # fixed-length episode: the end is a time limit, not a death state
+        info: Dict[str, Any] = {"truncated": True} if terminal else {}
+        return self._obs(), float(-cost), terminal, info
+
+
+class ReacherEnv(Env):
+    """Two-joint planar arm reaching a random target — the multi-dim
+    continuous-action env the DDPG family needs (the reference's DDPG
+    restricts itself to scalar action spaces via ``.item()``, reference
+    core/models/ddpg_mlp_model.py:74-78; BASELINE.json tracks MuJoCo
+    HalfCheetah/Humanoid configs that this image cannot run).
+
+    Dynamics: two damped torque-driven joints (decoupled inertia — a
+    deliberate simplification of the full manipulator equations; the RL
+    problem of coordinating a 2-dim action to steer a nonlinear fingertip
+    stays).  Link lengths 0.1/0.11 and the control/distance cost mirror the
+    gym Reacher convention.  Observation (10-dim float32):
+    cos/sin of both joints, both velocities, target xy, fingertip-target
+    delta.  Action: 2 torques in [-1,1]; 150-step episodes;
+    ``info["solved"]`` when the final fingertip lands within 5 cm.
+    """
+
+    L1, L2 = 0.1, 0.11
+    MAX_TORQUE = 1.0
+    DT = 0.05
+    DAMPING = 0.5
+    INERTIA = 0.1   # DT/INERTIA=0.5: qdot' = 0.75*qdot + 0.5*u — velocity
+    MAX_SPEED = 4.0  # carries memory (steady state 2*u; clip is headroom)
+
+    def __init__(self, env_params, process_ind: int = 0):
+        super().__init__(env_params, process_ind)
+        self.max_steps = 150
+        self.q = np.zeros(2)       # joint angles
+        self.qdot = np.zeros(2)    # joint velocities
+        self.target = np.zeros(2)
+        self._steps = 0
+
+    @property
+    def state_shape(self) -> Tuple[int, ...]:
+        return (10,)
+
+    @property
+    def action_space(self) -> ContinuousSpace:
+        return ContinuousSpace(dim=2, low=-self.MAX_TORQUE,
+                               high=self.MAX_TORQUE)
+
+    def _fingertip(self) -> np.ndarray:
+        x = self.L1 * np.cos(self.q[0]) \
+            + self.L2 * np.cos(self.q[0] + self.q[1])
+        y = self.L1 * np.sin(self.q[0]) \
+            + self.L2 * np.sin(self.q[0] + self.q[1])
+        return np.array([x, y])
+
+    def _obs(self) -> np.ndarray:
+        delta = self._fingertip() - self.target
+        return np.concatenate([
+            np.cos(self.q), np.sin(self.q), self.qdot * 0.1,
+            self.target, delta,
+        ]).astype(np.float32)
+
+    def _reset(self) -> np.ndarray:
+        self.q = self.rng.uniform(-np.pi, np.pi, size=2)
+        self.qdot = self.rng.uniform(-0.5, 0.5, size=2)
+        # target uniformly inside the reachable annulus (radius <= L1+L2)
+        r = np.sqrt(self.rng.uniform(0.0, 1.0)) * (self.L1 + self.L2)
+        phi = self.rng.uniform(-np.pi, np.pi)
+        self.target = np.array([r * np.cos(phi), r * np.sin(phi)])
+        self._steps = 0
+        return self._obs()
+
+    def _step(self, action) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
+        u = self.action_space.denormalize(np.asarray(action).reshape(2))
+        self.qdot = self.qdot + self.DT * (
+            u - self.DAMPING * self.qdot) / self.INERTIA
+        self.qdot = np.clip(self.qdot, -self.MAX_SPEED, self.MAX_SPEED)
+        self.q = self.q + self.DT * self.qdot
+        self._steps += 1
+        dist = float(np.linalg.norm(self._fingertip() - self.target))
+        reward = -(dist + 0.01 * float(np.square(u).sum()))
+        terminal = self._steps >= self.max_steps
+        info: Dict[str, Any] = {}
+        if terminal:
+            # a pure time limit, not a death state: flag truncation so the
+            # n-step assembler bootstraps the tail instead of zeroing it
+            # (ops/nstep.py truncation-vs-terminal handling)
+            info["truncated"] = True
+            info["solved"] = dist < 0.05
+        return self._obs(), reward, terminal, info
 
 
 def make_classic_env(env_params, process_ind: int = 0) -> Env:
@@ -139,4 +230,6 @@ def make_classic_env(env_params, process_ind: int = 0) -> Env:
         return CartPoleEnv(env_params, process_ind)
     if game == "pendulum":
         return PendulumEnv(env_params, process_ind)
+    if game == "reacher":
+        return ReacherEnv(env_params, process_ind)
     raise ValueError(f"unknown classic game: {game}")

@@ -191,6 +191,36 @@ class DevicePerReplay(DeviceReplay):
 
         return jax.jit(multi, donate_argnums=(0, 1) if donate else ())
 
+    # -- checkpoint: uniform-ring snapshot + the priority leaves -----------
+
+    def snapshot(self) -> dict:
+        st = jax.device_get(self.state)
+        fill, pos = int(st.fill), int(st.pos)
+        shift = -pos if fill == self.capacity else 0
+        out = {k: np.roll(np.asarray(getattr(st, k)), shift,
+                          axis=0)[:fill].copy()
+               for k in Transition._fields}
+        out["leaf_priority"] = np.roll(
+            np.asarray(st.priority), shift)[:fill].copy()
+        out["max_priority"] = np.asarray(st.max_priority).copy()
+        return out
+
+    def restore(self, data: dict) -> int:
+        n = super().restore(data)  # rows land at max priority...
+        if n and "leaf_priority" in data:
+            # ...then the saved (pre-exponentiated) leaves overwrite the
+            # fresh slots [pos-n, pos) so sampling resumes where it left off
+            st = self.state
+            pos = int(jax.device_get(st.pos))
+            idx = jnp.asarray(
+                (np.arange(pos - n, pos) % self.capacity).astype(np.int32))
+            pr = jnp.asarray(
+                np.asarray(data["leaf_priority"], np.float32)[-n:])
+            self.state = st._replace(
+                priority=st.priority.at[idx].set(pr),
+                max_priority=jnp.float32(data.get("max_priority", 1.0)))
+        return n
+
     def sample(self, batch_size: int, key: jax.Array,
                beta: float = 1.0) -> Batch:
         return self._sample_fn(self.state, key, batch_size=batch_size,

@@ -187,6 +187,29 @@ class DeviceReplay:
     def size(self) -> int:
         return int(self.state.fill)
 
+    # -- checkpoint (utils/checkpoint.py save_replay/load_replay) -----------
+
+    def snapshot(self) -> dict:
+        """Pull the valid HBM rows to host in AGE order (when full, the
+        cursor points at the oldest row; before that, [0, fill) is already
+        oldest-first)."""
+        st = jax.device_get(self.state)
+        fill, pos = int(st.fill), int(st.pos)
+        shift = -pos if fill == self.capacity else 0
+        return {k: np.roll(np.asarray(getattr(st, k)), shift,
+                           axis=0)[:fill].copy()
+                for k in Transition._fields}
+
+    def restore(self, data: dict) -> int:
+        """Refill via the normal chunked write path (works across capacity
+        changes, keeps the newest rows that fit).  Returns rows restored."""
+        rows = np.asarray(data["reward"])
+        n = min(len(rows), self.capacity)
+        if n:
+            self.feed_chunk(Transition(
+                *(np.asarray(data[k])[-n:] for k in Transition._fields)))
+        return n
+
     def feed_chunk(self, chunk: Transition) -> None:
         """Host->device ingest of a chunk of transitions (leading dim = chunk
         size).  Chunk sizes should be fixed (e.g. the actor flush size) to
@@ -256,6 +279,17 @@ class DeviceReplayIngest:
         # host-side accounting — no device sync in the hot loop
         assert self.replay is not None, "attach() first"
         return min(self._fed_total, self.replay.capacity)
+
+    # -- checkpoint: delegate to the attached HBM ring ---------------------
+
+    def snapshot(self) -> dict:
+        assert self.replay is not None, "attach() first"
+        self.drain()
+        return self.replay.snapshot()
+
+    def restore(self, data: dict) -> None:
+        assert self.replay is not None, "attach() first"
+        self._fed_total += self.replay.restore(data)
 
     def close(self) -> None:
         """See QueueOwner.close: reap the queue feeder thread."""

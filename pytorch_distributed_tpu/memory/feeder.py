@@ -78,6 +78,20 @@ class QueueOwner:
             self.memory.feed(transition, priority)
         return len(items)
 
+    # -- checkpoint: drain then delegate ------------------------------------
+
+    def snapshot(self) -> dict:
+        if not hasattr(self.memory, "snapshot"):
+            # e.g. SequenceReplay: checkpoint.save_replay skips cleanly
+            raise NotImplementedError(type(self.memory).__name__)
+        self.drain()
+        return self.memory.snapshot()
+
+    def restore(self, data: dict) -> None:
+        if not hasattr(self.memory, "restore"):
+            raise NotImplementedError(type(self.memory).__name__)
+        self.memory.restore(data)
+
     # -- delegated sampling surface ----------------------------------------
 
     @property

@@ -117,6 +117,40 @@ class PrioritizedReplay(Memory):
             index=idx.astype(np.int32),
         )
 
+    # -- checkpoint (utils/checkpoint.py save_replay/load_replay) -----------
+
+    def snapshot(self) -> dict:
+        """Valid rows in AGE order (oldest first) + tree LEAF priorities
+        (already alpha-exponentiated, so restore sets them back verbatim —
+        no double exponentiation)."""
+        n = self.size
+        shift = -self._pos if self._full else 0
+        out = {k: np.roll(getattr(self, k), shift, axis=0)[:n].copy()
+               for k in Transition._fields}
+        out["leaf_priority"] = np.roll(
+            self.sum_tree.get(np.arange(self.capacity)), shift)[:n].copy()
+        out["max_priority"] = np.float64(self.max_priority)
+        out["samples_drawn"] = np.int64(self._samples_drawn)
+        return out
+
+    def restore(self, data: dict) -> None:
+        rows = np.asarray(data["reward"])
+        n = min(len(rows), self.capacity)
+        for k in Transition._fields:
+            getattr(self, k)[:n] = data[k][-n:]
+        if "leaf_priority" in data:
+            leaves = np.asarray(data["leaf_priority"],
+                                dtype=np.float64)[-n:]
+        else:  # snapshot from a uniform ring: everything replays once
+            leaves = np.full(n, self._priority(None), dtype=np.float64)
+        idx = np.arange(n)
+        self.sum_tree.set(idx, leaves)
+        self.min_tree.set(idx, leaves)
+        self._pos = n % self.capacity
+        self._full = n == self.capacity
+        self.max_priority = float(data.get("max_priority", 1.0))
+        self._samples_drawn = int(data.get("samples_drawn", 0))
+
     def update_priorities(self, indices: np.ndarray,
                           priorities: np.ndarray) -> None:
         priorities = np.abs(np.asarray(priorities, dtype=np.float64)) + self.eps

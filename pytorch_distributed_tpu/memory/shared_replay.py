@@ -127,6 +127,35 @@ class SharedReplay(Memory):
             self._pos.value = nxt
             self._count.value += 1
 
+    # -- checkpoint (utils/checkpoint.py save_replay/load_replay) -----------
+
+    def snapshot(self) -> dict:
+        """Valid rows in AGE order (oldest first), atomically vs concurrent
+        feeds — restore's keep-the-newest truncation depends on it.  The
+        reference never checkpoints replay (SURVEY.md §5); this is the
+        resume tier's replay leg."""
+        with self._lock:
+            n = self.size
+            # when full, the cursor points at the oldest slot: roll so
+            # row 0 is oldest; when not full, [0:pos) is already age order
+            shift = -self._pos.value if self._full.value else 0
+            out = {k: np.roll(getattr(self, f"_np_{k}"), shift, axis=0)[:n]
+                   .copy() for k in self._raw}
+            out["count"] = np.int64(self._count.value)
+            return out
+
+    def restore(self, data: dict) -> None:
+        """Refill from a snapshot; tolerates a different capacity (keeps
+        the newest rows that fit)."""
+        with self._lock:
+            rows = np.asarray(data["reward"])
+            n = min(len(rows), self.capacity)
+            for k in self._raw:
+                getattr(self, f"_np_{k}")[:n] = data[k][-n:]
+            self._pos.value = n % self.capacity
+            self._full.value = int(n == self.capacity)
+            self._count.value = int(data.get("count", n))
+
     def sample(self, batch_size: int, rng: np.random.Generator) -> Batch:
         # uniform indices + float cast of states (reference
         # shared_memory.py:59-67); copies so the learner batch is stable

@@ -69,6 +69,49 @@ def save_train_state(model_name: str, state: Any) -> str:
     return path
 
 
+def replay_path(model_name: str) -> str:
+    return model_name + "_replay.npz"
+
+
+def save_replay(model_name: str, memory: Any) -> Optional[str]:
+    """Write the replay contents next to the train state — the resume leg
+    the reference never had (SURVEY.md §5 "Not checkpointed: ... replay").
+    Works for any memory exposing ``snapshot() -> dict`` (shared ring, PER
+    incl. leaf priorities, HBM device rings; queue owners drain-then-
+    delegate).  Returns the path, or None when the memory type has no
+    snapshot surface."""
+    import numpy as np
+
+    if not hasattr(memory, "snapshot"):
+        return None
+    try:
+        data = memory.snapshot()
+    except NotImplementedError:  # wrapper around an unsupported memory
+        return None
+    path = replay_path(model_name)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **data)
+    os.replace(tmp, path)
+    return path
+
+
+def load_replay(model_name: str, memory: Any) -> bool:
+    """Refill ``memory`` from a prior save_replay; False when absent or the
+    memory type has no restore surface."""
+    import numpy as np
+
+    path = replay_path(model_name)
+    if not hasattr(memory, "restore") or not os.path.exists(path):
+        return False
+    with np.load(path) as z:
+        try:
+            memory.restore({k: z[k] for k in z.files})
+        except NotImplementedError:
+            return False
+    return True
+
+
 def restore_train_state(model_name: str, template: Any) -> Optional[Any]:
     """Restore a TrainState saved by ``save_train_state``; None if absent."""
     import orbax.checkpoint as ocp

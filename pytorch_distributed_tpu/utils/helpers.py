@@ -50,6 +50,29 @@ def update_target(target: PyTree, online: PyTree, step: jnp.ndarray,
     return periodic_update(target, online, step, int(target_model_update))
 
 
+def host_cpu_device():
+    """The host CPU jax device — always present alongside any accelerator
+    backend."""
+    return jax.local_devices(backend="cpu")[0]
+
+
+def pin_to_cpu(tree: PyTree) -> PyTree:
+    """Commit a pytree to the host CPU device.  Rollout-side inference
+    (actors, evaluator, tester) pins its params/keys here so batch-1
+    forwards compile and run on the host instead of round-tripping a
+    (possibly tunnelled) accelerator — the learner alone owns the mesh
+    (SURVEY.md §7 design stance).  jit follows committed inputs, so no
+    backend= plumbing is needed in the act functions."""
+    return jax.device_put(tree, host_cpu_device())
+
+
+def unravel_on_cpu(unravel, flat) -> PyTree:
+    """unravel (ravel_pytree's inverse) onto the host CPU: the jnp concat/
+    reshape ops inside it would otherwise land on the default device."""
+    with jax.default_device(host_cpu_device()):
+        return pin_to_cpu(unravel(flat))
+
+
 def global_norm(tree: PyTree) -> jnp.ndarray:
     leaves = jax.tree_util.tree_leaves(tree)
     return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))

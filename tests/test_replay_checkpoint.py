@@ -1,0 +1,184 @@
+"""Replay-contents checkpointing (utils/checkpoint.py save_replay /
+load_replay) — the resume leg the reference never had (SURVEY.md §5
+"Not checkpointed: ... replay contents")."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.memory.prioritized import PrioritizedReplay
+from pytorch_distributed_tpu.memory.shared_replay import SharedReplay
+from pytorch_distributed_tpu.utils import checkpoint as ckpt
+from pytorch_distributed_tpu.utils.experience import Transition
+
+
+def fill(mem, n, seed=0, priorities=False):
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        t = Transition(
+            state0=rng.integers(0, 255, size=(4,)).astype(np.uint8),
+            action=np.int32(i % 3),
+            reward=np.float32(i),
+            gamma_n=np.float32(0.99),
+            state1=rng.integers(0, 255, size=(4,)).astype(np.uint8),
+            terminal1=np.float32(i % 7 == 0),
+        )
+        mem.feed(t, float(i % 5) if priorities else None)
+
+
+def geom(capacity):
+    return dict(capacity=capacity, state_shape=(4,), action_shape=(),
+                state_dtype=np.uint8, action_dtype=np.int32)
+
+
+def test_shared_roundtrip(tmp_path):
+    a = SharedReplay(**geom(64))
+    fill(a, 40)
+    path = ckpt.save_replay(str(tmp_path / "m"), a)
+    assert path and path.endswith("_replay.npz")
+    b = SharedReplay(**geom(64))
+    assert ckpt.load_replay(str(tmp_path / "m"), b)
+    assert b.size == 40
+    ba = b.sample(16, np.random.default_rng(0))
+    # restored rows carry the original contents
+    assert set(np.unique(ba.reward)).issubset(set(np.arange(40.0)))
+
+
+def test_shared_roundtrip_smaller_capacity_keeps_newest(tmp_path):
+    a = SharedReplay(**geom(64))
+    fill(a, 64)          # rewards 0..63 in slots 0..63
+    fill(a, 10, seed=1)  # wrap: rewards 0..9 overwrite slots 0..9 (newest)
+    ckpt.save_replay(str(tmp_path / "m"), a)
+    b = SharedReplay(**geom(32))
+    ckpt.load_replay(str(tmp_path / "m"), b)
+    assert b.size == 32  # newest rows that fit
+    # age order: newest 32 = first-pass rewards 42..63 + second-pass 0..9
+    got = sorted(b._np_reward[:32].tolist())
+    want = sorted(list(range(10)) + list(range(42, 64)))
+    assert got == [float(x) for x in want]
+
+
+def test_prioritized_roundtrip_preserves_leaves(tmp_path):
+    a = PrioritizedReplay(**geom(64))
+    fill(a, 50, priorities=True)
+    leaves_a = a.sum_tree.get(np.arange(50))
+    ckpt.save_replay(str(tmp_path / "m"), a)
+    b = PrioritizedReplay(**geom(64))
+    ckpt.load_replay(str(tmp_path / "m"), b)
+    assert b.size == 50
+    np.testing.assert_allclose(b.sum_tree.get(np.arange(50)), leaves_a)
+    assert b.max_priority == a.max_priority
+    # sampling works and IS weights are finite
+    batch = b.sample(16, np.random.default_rng(0))
+    assert np.isfinite(batch.weight).all()
+
+
+def test_device_ring_roundtrip(tmp_path):
+    from pytorch_distributed_tpu.memory.device_replay import DeviceReplay
+
+    a = DeviceReplay(**geom(64))
+    rng = np.random.default_rng(0)
+    n = 40
+    a.feed_chunk(Transition(
+        state0=rng.integers(0, 255, size=(n, 4)).astype(np.uint8),
+        action=rng.integers(0, 3, size=n).astype(np.int32),
+        reward=np.arange(n, dtype=np.float32),
+        gamma_n=np.full(n, 0.99, dtype=np.float32),
+        state1=rng.integers(0, 255, size=(n, 4)).astype(np.uint8),
+        terminal1=np.zeros(n, dtype=np.float32),
+    ))
+    ckpt.save_replay(str(tmp_path / "m"), a)
+    b = DeviceReplay(**geom(64))
+    ckpt.load_replay(str(tmp_path / "m"), b)
+    assert b.size == n
+    import jax
+
+    st = jax.device_get(b.state)
+    np.testing.assert_allclose(np.sort(np.asarray(st.reward)[:n]),
+                               np.arange(n, dtype=np.float32))
+
+
+def test_device_per_roundtrip_preserves_priorities(tmp_path):
+    from pytorch_distributed_tpu.memory.device_per import DevicePerReplay
+    import jax
+
+    a = DevicePerReplay(**geom(64))
+    rng = np.random.default_rng(0)
+    n = 30
+    a.feed_chunk(Transition(
+        state0=rng.integers(0, 255, size=(n, 4)).astype(np.uint8),
+        action=rng.integers(0, 3, size=n).astype(np.int32),
+        reward=np.arange(n, dtype=np.float32),
+        gamma_n=np.full(n, 0.99, dtype=np.float32),
+        state1=rng.integers(0, 255, size=(n, 4)).astype(np.uint8),
+        terminal1=np.zeros(n, dtype=np.float32),
+    ))
+    # make the leaves non-uniform, as after training write-backs
+    from pytorch_distributed_tpu.memory.device_per import (
+        per_update_priorities,
+    )
+
+    a.state = per_update_priorities(
+        a.state, np.arange(n, dtype=np.int32),
+        np.linspace(0.1, 3.0, n).astype(np.float32), alpha=a.alpha)
+    leaves_a = np.asarray(jax.device_get(a.state.priority))[:n].copy()
+    ckpt.save_replay(str(tmp_path / "m"), a)
+
+    b = DevicePerReplay(**geom(64))
+    ckpt.load_replay(str(tmp_path / "m"), b)
+    assert b.size == n
+    st = jax.device_get(b.state)
+    np.testing.assert_allclose(np.asarray(st.priority)[:n], leaves_a,
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        float(st.max_priority),
+        float(jax.device_get(a.state.max_priority)), rtol=1e-6)
+
+
+def test_missing_or_unsupported(tmp_path):
+    assert ckpt.save_replay(str(tmp_path / "m"), object()) is None
+    a = SharedReplay(**geom(8))
+    assert not ckpt.load_replay(str(tmp_path / "nothing"), a)
+    # a queue owner around a memory with no snapshot surface (e.g. the
+    # sequence replay) skips cleanly instead of crashing the learner
+    from pytorch_distributed_tpu.memory.feeder import QueueOwner
+
+    class NoSnapshot:
+        pass
+
+    owner = QueueOwner(NoSnapshot())
+    assert ckpt.save_replay(str(tmp_path / "m"), owner) is None
+    # restoring a uniform-ring snapshot into a PER buffer falls back to
+    # replay-once priorities instead of KeyError
+    big = SharedReplay(**geom(16))
+    fill(big, 12)
+    ckpt.save_replay(str(tmp_path / "u"), big)
+    per = PrioritizedReplay(**geom(16))
+    assert ckpt.load_replay(str(tmp_path / "u"), per)
+    assert per.size == 12
+    batch = per.sample(8, np.random.default_rng(0))
+    assert np.isfinite(batch.weight).all()
+
+
+def test_topology_resume_with_warm_replay(tmp_path):
+    """End to end: run, stop, resume — the second run starts with the first
+    run's replay AND train state (learner step continues)."""
+    from pytorch_distributed_tpu import runtime
+    from pytorch_distributed_tpu.config import build_options
+
+    common = dict(
+        root_dir=str(tmp_path), num_actors=1, learn_start=64,
+        batch_size=32, memory_size=2048, logger_freq=1, evaluator_freq=5,
+        visualize=False, max_replay_ratio=16.0, early_stop=25,
+        checkpoint_replay=True,
+    )
+    opt = build_options(config=1, steps=200, **common)
+    topo = runtime.train(opt, backend="thread")
+    assert topo.clock.learner_step.value >= 200
+    assert (tmp_path / "models" / (opt.refs + "_replay.npz")).exists()
+
+    opt2 = build_options(config=1, steps=400, refs=opt.refs, **common)
+    topo2 = runtime.train(opt2, backend="thread")
+    # step counter resumed past the first run's 200 and reached 400
+    assert topo2.clock.learner_step.value >= 400

@@ -144,6 +144,23 @@ def test_native_ring_topology_runs(tmp_path):
     assert topo.handles.learner_side.total_feeds > 0
 
 
+def test_ddpg_reacher_multidim_topology_runs(tmp_path):
+    """The 2-dim continuous action path end to end: OU noise shaped
+    (num_envs, 2), decoupled two-optimizer DDPG update, tester reload."""
+    opt = _opts(tmp_path, config=16, steps=200, learn_start=64,
+                batch_size=32)
+    topo = runtime.train(opt, backend="thread")
+    assert topo.clock.learner_step.value >= 200
+    recs = read_scalars(opt.log_dir)
+    tags = {r["tag"] for r in recs}
+    assert "learner/actor_loss" in tags and "actor/avg_reward" in tags
+    opt2 = _opts(tmp_path, config=16, mode=2, tester_nepisodes=2,
+                 model_file=opt.model_name)
+    out = runtime.test(opt2)
+    assert out["nepisodes"] == 2.0
+    assert out["avg_reward"] < 0.0  # negative-cost env; sanity only
+
+
 def test_vector_env_actor_topology(tmp_path):
     # early_stop 12 < learn_start/4 envs: all four env slots truncate an
     # episode during replay warmup regardless of scheduling
