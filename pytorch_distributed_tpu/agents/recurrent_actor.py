@@ -45,9 +45,13 @@ class _RecurrentHarness(_ActorHarness):
             SegmentBuilder(ap.seq_len, ap.seq_overlap,
                            state_dtype=state_dtype)
             for _ in range(self.num_envs)]
-        # one batched carry; per-env rows reset at episode ends
+        # one batched carry; per-env rows reset at episode ends.  The
+        # initial-carry rows are precomputed host-side once so per-episode
+        # resets never allocate on the accelerator
         self.carry = tuple(np.asarray(c) for c in
                            self.model.zero_carry(self.num_envs))
+        self._init_carry = tuple(np.asarray(c)
+                                 for c in self.model.zero_carry(1))
 
     # segments replace transitions: override the per-env feed
     def advance(self, actions, next_obs, rewards, terminals, infos,
@@ -73,11 +77,10 @@ class _RecurrentHarness(_ActorHarness):
             self.episode_reward[j] += float(rewards[j])
             if terminals[j]:
                 self._record_episode(j, infos[j])
-                # fresh episode: zero carry + fresh segment stream
-                # (plain zeros, not model.zero_carry: a device alloc here
-                # would hit the accelerator once per episode end)
-                carry_after[0][j] = 0.0
-                carry_after[1][j] = 0.0
+                # fresh episode: model-defined initial carry + fresh
+                # segment stream (host-side copy of the precomputed rows)
+                for c_row, c_init in zip(carry_after, self._init_carry):
+                    c_row[j] = c_init[0]
                 self.builders[j].reset()
         self._obs = next_obs
         self.carry = carry_after

@@ -202,7 +202,11 @@ class DevicePerReplay(DeviceReplay):
                for k in Transition._fields}
         out["leaf_priority"] = np.roll(
             np.asarray(st.priority), shift)[:fill].copy()
-        out["max_priority"] = np.asarray(st.max_priority).copy()
+        # stored p^alpha on device; snapshot in the shared UNexponentiated
+        # unit so host<->device PER resumes agree
+        mx = float(np.asarray(st.max_priority))
+        out["max_priority_base"] = np.float64(
+            mx ** (1.0 / self.alpha) if self.alpha else mx)
         return out
 
     def restore(self, data: dict) -> int:
@@ -216,9 +220,11 @@ class DevicePerReplay(DeviceReplay):
                 (np.arange(pos - n, pos) % self.capacity).astype(np.int32))
             pr = jnp.asarray(
                 np.asarray(data["leaf_priority"], np.float32)[-n:])
+            base = float(data.get("max_priority_base", 1.0))
             self.state = st._replace(
                 priority=st.priority.at[idx].set(pr),
-                max_priority=jnp.float32(data.get("max_priority", 1.0)))
+                max_priority=jnp.float32(
+                    base ** self.alpha if self.alpha else base))
         return n
 
     def sample(self, batch_size: int, key: jax.Array,

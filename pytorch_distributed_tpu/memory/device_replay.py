@@ -254,6 +254,7 @@ class DeviceReplayIngest:
             {min(s, capacity)
              for s in (chunk_size, chunk_size * 8, chunk_size * 64)},
             reverse=True))
+        self.max_queue_chunks = max_queue_chunks  # backpressure bound
         self._q = mp.get_context("spawn").Queue(max_queue_chunks)
         self.replay: Optional[DeviceReplay] = None
         self._pending: list = []
@@ -284,7 +285,22 @@ class DeviceReplayIngest:
 
     def snapshot(self) -> dict:
         assert self.replay is not None, "attach() first"
-        self.drain()
+        while self.drain():  # a deep backlog needs multiple capped drains
+            pass
+        if self._pending:
+            # sub-chunk remainder: the drain cadence leaves rows below the
+            # smallest preset chunk size pending; a checkpoint must not
+            from pytorch_distributed_tpu.utils.experience import (
+                transition_dtypes,
+            )
+
+            dt = transition_dtypes(self.replay.state_dtype,
+                                   self.replay.action_dtype)
+            rows, self._pending = self._pending, []
+            self.replay.feed_chunk(Transition(*(
+                np.stack([getattr(r, f) for r in rows]).astype(dt[f])
+                for f in Transition._fields)))
+            self._fed_total += len(rows)
         return self.replay.snapshot()
 
     def restore(self, data: dict) -> None:
@@ -296,8 +312,10 @@ class DeviceReplayIngest:
         # discard rather than flush: leftover experience is garbage at
         # shutdown, and join_thread would block forever on a full pipe
         # nobody drains anymore
-        self._q.cancel_join_thread()
-        self._q.close()
+        if hasattr(self._q, "cancel_join_thread"):  # mp queue only
+            self._q.cancel_join_thread()
+        if hasattr(self._q, "close"):  # queue.Queue has no close
+            self._q.close()
 
     def drain(self, max_chunks: int = 1024,
               max_rows: int = 32768) -> int:

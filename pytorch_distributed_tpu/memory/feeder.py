@@ -33,6 +33,12 @@ class QueueFeeder:
         self._chunk = chunk
         self._buf: List[Tuple[Transition, Optional[float]]] = []
 
+    def clone(self) -> "QueueFeeder":
+        """Same queue, fresh chunk buffer — thread-backend workers each get
+        their own clone so the buffer is never shared across threads (the
+        process backend gets per-child copies from pickling anyway)."""
+        return QueueFeeder(self._q, self._chunk)
+
     def feed(self, transition: Transition,
              priority: Optional[float] = None) -> None:
         self._buf.append((transition, priority))
@@ -66,6 +72,7 @@ class QueueOwner:
 
     def __init__(self, memory, max_queue_chunks: int = 4096):
         self.memory = memory
+        self.max_queue_chunks = max_queue_chunks  # backpressure bound
         self._q = _CTX.Queue(max_queue_chunks)
 
     def make_feeder(self, chunk: int = 16) -> QueueFeeder:
@@ -84,7 +91,8 @@ class QueueOwner:
         if not hasattr(self.memory, "snapshot"):
             # e.g. SequenceReplay: checkpoint.save_replay skips cleanly
             raise NotImplementedError(type(self.memory).__name__)
-        self.drain()
+        while self.drain():  # a deep backlog needs multiple capped drains
+            pass
         return self.memory.snapshot()
 
     def restore(self, data: dict) -> None:
@@ -119,5 +127,7 @@ class QueueOwner:
         from C++ teardown.  Pending items are discarded, not flushed:
         leftover experience is garbage at shutdown, and joining a feeder
         blocked on a full pipe nobody drains anymore deadlocks the run."""
-        self._q.cancel_join_thread()
-        self._q.close()
+        if hasattr(self._q, "cancel_join_thread"):  # mp queue only
+            self._q.cancel_join_thread()
+        if hasattr(self._q, "close"):  # queue.Queue has no close
+            self._q.close()

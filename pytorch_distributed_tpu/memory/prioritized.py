@@ -129,7 +129,9 @@ class PrioritizedReplay(Memory):
                for k in Transition._fields}
         out["leaf_priority"] = np.roll(
             self.sum_tree.get(np.arange(self.capacity)), shift)[:n].copy()
-        out["max_priority"] = np.float64(self.max_priority)
+        # UNexponentiated, the unit every restore path expects — the device
+        # PER converts its p^alpha running max to base on snapshot too
+        out["max_priority_base"] = np.float64(self.max_priority)
         out["samples_drawn"] = np.int64(self._samples_drawn)
         return out
 
@@ -148,7 +150,7 @@ class PrioritizedReplay(Memory):
         self.min_tree.set(idx, leaves)
         self._pos = n % self.capacity
         self._full = n == self.capacity
-        self.max_priority = float(data.get("max_priority", 1.0))
+        self.max_priority = float(data.get("max_priority_base", 1.0))
         self._samples_drawn = int(data.get("samples_drawn", 0))
 
     def update_priorities(self, indices: np.ndarray,

@@ -275,9 +275,12 @@ def feed_queue_of(memory_handles) -> Callable[[list], None]:
     queue.  Multi-writer shared rings (SharedReplay/NativeRingReplay) take
     direct feeds — their ``feed`` is already cross-process safe."""
     learner_side = memory_handles.learner_side
-    q = getattr(learner_side, "_q", None)
-    if q is not None:
-        return q.put
+    if getattr(learner_side, "_q", None) is not None:
+        # late-bound: Topology._use_thread_queue may swap the queue object
+        # between construction and run
+        def _enqueue(items: list) -> None:
+            learner_side._q.put(items)
+        return _enqueue
 
     def _direct(items: list) -> None:
         for t, p in items:

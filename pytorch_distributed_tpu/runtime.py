@@ -82,8 +82,13 @@ class Topology:
         specs = [("logger", 0, (opt, self.clock, self.actor_stats,
                                 self.learner_stats, self.evaluator_stats))]
         for i in range(opt.num_actors):
+            # per-actor feeder clone: thread workers must not share one
+            # chunk buffer (process children get their own pickled copy)
+            side = self.handles.actor_side
+            if hasattr(side, "clone"):
+                side = side.clone()
             specs.append(("actor", i, (
-                opt, spec, i, self.handles.actor_side, self.param_store,
+                opt, spec, i, side, self.param_store,
                 self.clock, self.actor_stats)))
         specs.append(("evaluator", 0, (
             opt, spec, 0, None, self.param_store, self.clock,
@@ -98,6 +103,8 @@ class Topology:
         assert backend in ("process", "thread")
         opt = self.opt
         prebuild_native(opt)  # once, before N workers race the same g++
+        if backend == "thread":
+            self._use_thread_queue()
         if backend == "process":
             self._proc_meta = []
             for role, ind, args in self._worker_specs():
@@ -128,6 +135,23 @@ class Topology:
 
     def _pre_close(self) -> None:
         """Hook: extra transports to tear down before learner_side closes."""
+
+    def _use_thread_queue(self) -> None:
+        """In-process workers don't need the spawn-context queue: mp.Queue
+        pickles every chunk (a uint8 Atari transition is ~56 KB, so a
+        16-chunk put copies ~1 MB through a pipe), while queue.Queue hands
+        over references.  Swap the shared queue before any worker starts;
+        feeder clones made in _worker_specs pick the new queue up."""
+        import queue as _q
+
+        ls, as_ = self.handles.learner_side, self.handles.actor_side
+        if hasattr(ls, "_q") and hasattr(as_, "_q") and ls._q is as_._q:
+            # keep the mp queue's chunk bound: backpressure must still
+            # stall producers when the learner falls behind, or drains
+            # balloon into multi-GB backlog copies
+            tq = _q.Queue(getattr(ls, "max_queue_chunks", 4096))
+            ls._q = tq
+            as_._q = tq
 
     def _spawn(self, role: str, ind: int, args: tuple) -> None:
         p = _CTX.Process(

@@ -75,8 +75,13 @@ def test_dqn_chain_learns_optimal_policy(tmp_path):
     # max_replay_ratio pins the learner/actor pace so the outcome doesn't
     # depend on thread scheduling (a warm jit cache otherwise lets the
     # learner burn its step budget before actors fill the replay).
-    opt = _opts(tmp_path, config=1, steps=1500, num_actors=2,
-                lr=5e-3, nstep=3, eps=0.4, max_replay_ratio=8.0)
+    # early_stop stays at the env default here (unlike the smoke tests'
+    # small caps): on the chain, an uncapped random walk reaches the
+    # rewarded end almost surely, so replay always carries reward signal —
+    # capping at 50 made roughly half the seeds learn nothing
+    opt = _opts(tmp_path, config=1, steps=3000, num_actors=2,
+                lr=5e-3, nstep=3, eps=0.5, max_replay_ratio=16.0,
+                early_stop=12500)
     runtime.train(opt, backend="thread")
     opt2 = _opts(tmp_path, config=1, mode=2, tester_nepisodes=5,
                  model_file=opt.model_name)
