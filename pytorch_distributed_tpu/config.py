@@ -264,6 +264,10 @@ class ParallelParams:
     # ring attention moves K/V around this axis over ICI
     # (ops/ring_attention.py)
     sp_size: int = 1
+    # sp strategy: "ring" (K/V rotation, any head count) or "ulysses"
+    # (head/time all-to-all, needs heads % sp == 0;
+    # ops/ulysses_attention.py docstring has the trade-off)
+    sp_attention: str = "ring"
     # Donate learner buffers (params/opt_state) to the jit step.
     donate: bool = True
     # Multi-host: call jax.distributed.initialize (DCN) before device init.

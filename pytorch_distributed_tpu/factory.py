@@ -360,16 +360,23 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
             train_model = model
             sp = mesh.shape.get("sp", 1) if mesh is not None else 1
             if sp > 1:
-                # long windows: shard the time axis over sp, attention
-                # rides the ring (same params, same math)
+                # long windows: shard the time axis over sp; attention
+                # rides the ring or the Ulysses all-to-all (same params,
+                # same math either way)
                 from pytorch_distributed_tpu.models.dtqn import (
-                    with_ring_attention,
+                    with_ring_attention, with_ulysses_attention,
                 )
 
                 assert (ap.seq_len + 1) % sp == 0, (
                     f"sequence-parallel DTQN needs window seq_len+1="
                     f"{ap.seq_len + 1} divisible by mesh sp={sp}")
-                train_model = with_ring_attention(model, mesh)
+                strategy = opt.parallel_params.sp_attention
+                if strategy == "ulysses":
+                    train_model = with_ulysses_attention(model, mesh)
+                else:
+                    assert strategy == "ring", (
+                        f"unknown sp_attention: {strategy}")
+                    train_model = with_ring_attention(model, mesh)
             window_apply = lambda p, obs: train_model.apply(
                 p, obs, method=train_model.window_q)
             step = build_dtqn_train_step(window_apply, tx, **kw)

@@ -152,16 +152,32 @@ class DtqnMlpModel(nn.Module):
         return self._encode(obs_seq, None)
 
 
-def with_ring_attention(model: DtqnMlpModel, mesh) -> DtqnMlpModel:
-    """Clone the model with its attention swapped for sequence-parallel
-    ring attention over ``mesh``'s sp axis — same params, same math (up to
-    fp order); the learner uses this when windows outgrow one device
+def _with_sp_attention(model: DtqnMlpModel, mesh, attn_fn) -> DtqnMlpModel:
+    """Clone the model with its attention swapped for a sequence-parallel
+    strategy over ``mesh``'s sp axis — same params, same math (up to fp
+    order); the learner uses this when windows outgrow one device
     (parallel_params.sp_size > 1)."""
     import dataclasses
     import functools
 
+    return dataclasses.replace(
+        model, attn=functools.partial(attn_fn, mesh=mesh,
+                                      axis="sp", batch_axis="dp"))
+
+
+def with_ring_attention(model: DtqnMlpModel, mesh) -> DtqnMlpModel:
+    """Ring K/V rotation (ops/ring_attention.py) — works for any head
+    count."""
     from pytorch_distributed_tpu.ops.ring_attention import ring_attention
 
-    return dataclasses.replace(
-        model, attn=functools.partial(ring_attention, mesh=mesh,
-                                      axis="sp", batch_axis="dp"))
+    return _with_sp_attention(model, mesh, ring_attention)
+
+
+def with_ulysses_attention(model: DtqnMlpModel, mesh) -> DtqnMlpModel:
+    """Ulysses head/time all-to-all (ops/ulysses_attention.py) — needs
+    heads divisible by the sp axis size (parallel_params.sp_attention)."""
+    from pytorch_distributed_tpu.ops.ulysses_attention import (
+        ulysses_attention,
+    )
+
+    return _with_sp_attention(model, mesh, ulysses_attention)

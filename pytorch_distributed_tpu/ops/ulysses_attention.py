@@ -1,0 +1,63 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second long-context strategy next to ring attention
+(ops/ring_attention.py; no reference equivalent — the reference has no
+attention at all).  Where the ring rotates K/V blocks and keeps the
+sequence axis sharded throughout, Ulysses (Jacobs et al. 2023, DeepSpeed
+Ulysses) re-shards: one all-to-all over the sp axis turns
+time-sharded (B, H, T/n, D) into head-sharded (B, H/n, T, D), every device
+runs plain full attention over its head subset with the ENTIRE sequence
+visible, and a second all-to-all restores time sharding.
+
+Trade-off vs the ring (why both exist): Ulysses moves Q, K, V and the
+output once each (4 all-to-alls total) regardless of sequence length and
+then runs the cheapest possible attention body; the ring moves K/V
+``n-1`` times but never materialises full-T scores and supports head
+counts smaller than the mesh axis.  Short-to-medium windows with enough
+heads favor Ulysses; very long windows or few-head models favor the ring.
+
+``ulysses_attention`` matches ``full_attention`` exactly up to fp
+reduction order; the equivalence tests pin all three against each other
+on the 8-virtual-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_tpu.ops.ring_attention import full_attention
+
+
+def _ulysses_body(q, k, v, *, axis_name: str, causal: bool):
+    # (B, H, T_local, D) time-sharded -> (B, H/n, T, D) head-sharded
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=1, concat_axis=2, tiled=True)
+    qf, kf, vf = a2a(q), a2a(k), a2a(v)
+    out = full_attention(qf, kf, vf, causal=causal)
+    # heads back together, time back to shards
+    return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, axis: str = "sp", causal: bool = True,
+                      batch_axis: Optional[str] = "dp") -> jnp.ndarray:
+    """Sequence-parallel attention via head/time all-to-all: (B, H, T, D)
+    with T sharded over ``axis`` (and optionally B over ``batch_axis``).
+    Requires H divisible by the sp axis size."""
+    n = mesh.shape[axis]
+    assert q.shape[1] % n == 0, (
+        f"ulysses needs heads {q.shape[1]} divisible by mesh {axis}={n} "
+        "(use ring attention for few-head models)")
+    bspec = batch_axis if (batch_axis and mesh.shape[batch_axis] > 1) \
+        else None
+    spec = P(bspec, None, axis, None)
+    body = functools.partial(_ulysses_body, axis_name=axis, causal=causal)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
