@@ -372,6 +372,10 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
                     f"{ap.seq_len + 1} divisible by mesh sp={sp}")
                 strategy = opt.parallel_params.sp_attention
                 if strategy == "ulysses":
+                    assert opt.model_params.tf_heads % sp == 0, (
+                        f"sp_attention=ulysses needs tf_heads="
+                        f"{opt.model_params.tf_heads} divisible by mesh "
+                        f"sp={sp} (use sp_attention=ring otherwise)")
                     train_model = with_ulysses_attention(model, mesh)
                 else:
                     assert strategy == "ring", (

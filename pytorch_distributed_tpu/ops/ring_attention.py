@@ -114,18 +114,25 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, num_blocks: int):
     return o / jnp.maximum(l[..., None], 1e-30)
 
 
+def sharded_attention_call(body, q, k, v, mesh: Mesh, axis: str,
+                           batch_axis: Optional[str]) -> jnp.ndarray:
+    """Shared shard_map entry for the sequence-parallel strategies: T
+    sharded over ``axis``, B optionally over ``batch_axis``; ``body`` is
+    the per-device (q, k, v) -> out function (ring or Ulysses)."""
+    bspec = batch_axis if (batch_axis and mesh.shape[batch_axis] > 1) \
+        else None
+    spec = P(bspec, None, axis, None)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, axis: str = "sp", causal: bool = True,
                    batch_axis: Optional[str] = "dp") -> jnp.ndarray:
     """Sequence-parallel attention: (B, H, T, D) with T sharded over
     ``axis`` (and optionally B over ``batch_axis``).  Matches
     ``full_attention`` up to fp reduction order."""
-    num_blocks = mesh.shape[axis]
-    bspec = batch_axis if (batch_axis and mesh.shape[batch_axis] > 1) \
-        else None
-    spec = P(bspec, None, axis, None)
     body = functools.partial(_ring_body, axis_name=axis, causal=causal,
-                             num_blocks=num_blocks)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
-    return fn(q, k, v)
+                             num_blocks=mesh.shape[axis])
+    return sharded_attention_call(body, q, k, v, mesh, axis, batch_axis)

@@ -28,9 +28,11 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from pytorch_distributed_tpu.ops.ring_attention import full_attention
+from pytorch_distributed_tpu.ops.ring_attention import (
+    full_attention, sharded_attention_call,
+)
 
 
 def _ulysses_body(q, k, v, *, axis_name: str, causal: bool):
@@ -54,10 +56,5 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     assert q.shape[1] % n == 0, (
         f"ulysses needs heads {q.shape[1]} divisible by mesh {axis}={n} "
         "(use ring attention for few-head models)")
-    bspec = batch_axis if (batch_axis and mesh.shape[batch_axis] > 1) \
-        else None
-    spec = P(bspec, None, axis, None)
     body = functools.partial(_ulysses_body, axis_name=axis, causal=causal)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
-    return fn(q, k, v)
+    return sharded_attention_call(body, q, k, v, mesh, axis, batch_axis)
