@@ -1,0 +1,68 @@
+// Bilinear uint8 image resize — the frame-preprocessing kernel of the
+// Atari pipeline (reference core/envs/atari_env.py:53-58 resizes the
+// grayscale screen to 84x84 with cv2.INTER_LINEAR; this removes the
+// OpenCV dependency with a first-party implementation).
+//
+// Convention: pixel-center alignment (the cv2.INTER_LINEAR convention) —
+// src coordinate of output pixel i is (i + 0.5) * (in/out) - 0.5, clamped
+// into the source, interpolated in double, rounded half-up to uint8.
+// pytorch_distributed_tpu/utils/image.py holds the bit-identical numpy
+// reference the tests pin this against.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+inline double clampd(double v, double lo, double hi) {
+  return v < lo ? lo : (v > hi ? hi : v);
+}
+
+struct Axis {
+  std::vector<int> i0, i1;
+  std::vector<double> frac;
+  Axis(int in, int out) : i0(out), i1(out), frac(out) {
+    const double scale = (double)in / out;
+    for (int i = 0; i < out; ++i) {
+      double s = clampd((i + 0.5) * scale - 0.5, 0.0, in - 1.0);
+      int lo = (int)std::floor(s);
+      i0[i] = lo;
+      i1[i] = std::min(lo + 1, in - 1);
+      frac[i] = s - lo;
+    }
+  }
+};
+
+void resize_one(const uint8_t* src, int h, int w, uint8_t* dst,
+                const Axis& ay, const Axis& ax, int oh, int ow) {
+  for (int y = 0; y < oh; ++y) {
+    const uint8_t* r0 = src + ay.i0[y] * w;
+    const uint8_t* r1 = src + ay.i1[y] * w;
+    const double fy = ay.frac[y];
+    uint8_t* out = dst + y * ow;
+    for (int x = 0; x < ow; ++x) {
+      const double fx = ax.frac[x];
+      const double top = r0[ax.i0[x]] * (1.0 - fx) + r0[ax.i1[x]] * fx;
+      const double bot = r1[ax.i0[x]] * (1.0 - fx) + r1[ax.i1[x]] * fx;
+      out[x] = (uint8_t)(top * (1.0 - fy) + bot * fy + 0.5);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// src: (n, h, w) uint8 contiguous; dst: (n, oh, ow) uint8
+void resize_bilinear_u8(const uint8_t* src, int n, int h, int w,
+                        uint8_t* dst, int oh, int ow) {
+  if (n <= 0 || h <= 0 || w <= 0 || oh <= 0 || ow <= 0) return;
+  Axis ay(h, oh), ax(w, ow);
+  for (int i = 0; i < n; ++i)
+    resize_one(src + (size_t)i * h * w, h, w,
+               dst + (size_t)i * oh * ow, ay, ax, oh, ow);
+}
+
+}  // extern "C"

@@ -32,11 +32,7 @@ from typing import Any, Dict, Tuple
 import numpy as np
 
 from pytorch_distributed_tpu.envs.base import DiscreteSpace, Env
-
-try:  # pragma: no cover - exercised only where an ALE wheel exists
-    import cv2
-except Exception:  # noqa: BLE001
-    cv2 = None
+from pytorch_distributed_tpu.utils.image import resize_bilinear
 
 
 def _load_ale(game: str, seed: int, max_num_frames: int):
@@ -73,8 +69,6 @@ def _load_ale(game: str, seed: int, max_num_frames: int):
 class AtariEnv(Env):
     def __init__(self, env_params, process_ind: int = 0):
         super().__init__(env_params, process_ind)
-        if cv2 is None:
-            raise ImportError("AtariEnv requires OpenCV (cv2) for resizing")
         self.norm_val = 255.0
         self.hist_len = env_params.state_cha
         self.ale, self.actions = _load_ale(
@@ -97,11 +91,11 @@ class AtariEnv(Env):
         gray = self.ale.getScreenGrayscale()
         gray = np.asarray(gray).reshape(self.ale.getScreenDims()[::-1] if
                                         gray.ndim == 1 else gray.shape)
-        return cv2.resize(
+        # first-party bilinear resize (utils/image.py; the reference used
+        # cv2.INTER_LINEAR, reference atari_env.py:56) — no cv2 dependency
+        return resize_bilinear(
             gray.squeeze().astype(np.uint8),
-            (self.params.state_wid, self.params.state_hei),
-            interpolation=cv2.INTER_LINEAR,
-        )
+            (self.params.state_hei, self.params.state_wid))
 
     def _stacked(self) -> np.ndarray:
         return np.stack(self.frame_stack)

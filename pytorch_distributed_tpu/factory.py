@@ -178,24 +178,24 @@ def prebuild_native(opt: Options) -> None:
     gates."""
     import warnings
 
-    if _wants_native_pong(opt):
+    def _prebuild(name: str, fallback: str) -> None:
         try:
             from native.build import build_library
 
-            build_library("pong_batch", timeout=600.0)
+            build_library(name, timeout=600.0)
         except Exception as e:  # noqa: BLE001 - degrade with a loud flag
-            warnings.warn(f"parent-side native pong build FAILED ({e}); "
-                          "all workers will run the slower Python env",
-                          stacklevel=2)
-    if opt.memory_type == "native":
-        try:
-            from native.build import build_library
+            warnings.warn(f"parent-side native {name} build FAILED ({e}); "
+                          f"{fallback}", stacklevel=3)
 
-            build_library("ring_buffer", timeout=600.0)
-        except Exception as e:  # noqa: BLE001
-            warnings.warn(f"parent-side native ring build FAILED ({e}); "
-                          "workers fall back to the Python shared replay",
-                          stacklevel=2)
+    if _wants_native_pong(opt):
+        _prebuild("pong_batch",
+                  "all workers will run the slower Python env")
+    if opt.memory_type == "native":
+        _prebuild("ring_buffer",
+                  "workers fall back to the Python shared replay")
+    if opt.env_type == "atari":
+        _prebuild("image_ops",
+                  "frame preprocessing falls back to numpy")
 
 
 def probe_env(opt: Options) -> EnvSpec:

@@ -1,0 +1,80 @@
+"""Frame preprocessing: bilinear uint8 resize without OpenCV.
+
+The reference Atari pipeline resizes the grayscale ALE screen with
+``cv2.resize(..., INTER_LINEAR)`` (reference core/envs/atari_env.py:53-58);
+this image ships no cv2, so the resize is first-party: a C++ kernel
+(native/image_ops.cpp) with a bit-identical vectorized numpy fallback.
+Convention (both paths): pixel-center alignment — the source coordinate of
+output pixel i is ``(i + 0.5) * (in/out) - 0.5`` clamped into the source —
+interpolated in float64 and rounded half-up to uint8.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+from typing import Optional, Tuple
+
+import numpy as np
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _native_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is None and not _lib_failed:
+        try:
+            from native.build import load_library
+
+            lib = load_library("image_ops")
+            lib.resize_bilinear_u8.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+            _lib = lib
+        except Exception:  # noqa: BLE001 - no toolchain: numpy fallback
+            _lib_failed = True
+    return _lib
+
+
+@functools.lru_cache(maxsize=8)
+def _axis(n_in: int, n_out: int):
+    s = np.clip((np.arange(n_out) + 0.5) * (n_in / n_out) - 0.5,
+                0.0, n_in - 1.0)
+    i0 = np.floor(s).astype(np.intp)
+    i1 = np.minimum(i0 + 1, n_in - 1)
+    return i0, i1, s - i0
+
+
+def resize_bilinear_np(frames: np.ndarray, size: Tuple[int, int]
+                       ) -> np.ndarray:
+    """Numpy reference: (..., H, W) uint8 -> (..., oh, ow) uint8."""
+    oh, ow = size
+    h, w = frames.shape[-2], frames.shape[-1]
+    y0, y1, fy = _axis(h, oh)
+    x0, x1, fx = _axis(w, ow)
+    f = frames.astype(np.float64)
+    ty, tb = f[..., y0, :], f[..., y1, :]
+    top = ty[..., :, x0] * (1 - fx) + ty[..., :, x1] * fx
+    bot = tb[..., :, x0] * (1 - fx) + tb[..., :, x1] * fx
+    out = top * (1 - fy)[:, None] + bot * fy[:, None]
+    return np.floor(out + 0.5).astype(np.uint8)
+
+
+def resize_bilinear(frames: np.ndarray, size: Tuple[int, int]
+                    ) -> np.ndarray:
+    """(..., H, W) uint8 -> (..., oh, ow) uint8 via the native kernel when
+    the toolchain built it, else the numpy reference (same bits)."""
+    frames = np.ascontiguousarray(frames, dtype=np.uint8)
+    oh, ow = size
+    lib = _native_lib()
+    if lib is None:
+        return resize_bilinear_np(frames, size)
+    lead = frames.shape[:-2]
+    h, w = frames.shape[-2], frames.shape[-1]
+    n = int(np.prod(lead)) if lead else 1
+    out = np.empty((*lead, oh, ow), dtype=np.uint8)
+    lib.resize_bilinear_u8(
+        frames.ctypes.data_as(ctypes.c_void_p), n, h, w,
+        out.ctypes.data_as(ctypes.c_void_p), oh, ow)
+    return out

@@ -1,0 +1,59 @@
+"""First-party bilinear resize (native/image_ops.cpp + utils/image.py) —
+the cv2-free frame preprocessor for the Atari pipeline."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.utils.image import (
+    _native_lib, resize_bilinear, resize_bilinear_np,
+)
+
+
+def test_identity_resize():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, size=(84, 84)).astype(np.uint8)
+    np.testing.assert_array_equal(resize_bilinear_np(img, (84, 84)), img)
+    np.testing.assert_array_equal(resize_bilinear(img, (84, 84)), img)
+
+
+def test_constant_and_ramp():
+    const = np.full((210, 160), 77, dtype=np.uint8)
+    out = resize_bilinear_np(const, (84, 84))
+    assert out.shape == (84, 84)
+    np.testing.assert_array_equal(out, 77)
+    # a horizontal ramp stays monotone after downscale
+    ramp = np.tile(np.linspace(0, 255, 160).astype(np.uint8), (210, 1))
+    out = resize_bilinear_np(ramp, (84, 84))
+    assert (np.diff(out[0].astype(int)) >= 0).all()
+
+
+@pytest.mark.skipif(_native_lib() is None,
+                    reason="native toolchain unavailable")
+@pytest.mark.parametrize("shape,size", [
+    ((210, 160), (84, 84)),     # the Atari case (reference atari_env.py:56)
+    ((84, 84), (42, 42)),
+    ((50, 70), (84, 84)),       # upscale
+    ((3, 210, 160), (84, 84)),  # batched frames
+])
+def test_native_matches_numpy(shape, size):
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 256, size=shape).astype(np.uint8)
+    np.testing.assert_array_equal(resize_bilinear(img, size),
+                                  resize_bilinear_np(img, size))
+
+
+def test_atari_env_uses_it():
+    """AtariEnv imports stay ALE-gated but cv2-free: constructing without
+    an ALE wheel raises the ALE ImportError, never a cv2 one."""
+    try:
+        import ale_py  # noqa: F401
+        pytest.skip("ale_py installed; the gate under test is its absence")
+    except ImportError:
+        pass
+    from pytorch_distributed_tpu.config import EnvParams
+    from pytorch_distributed_tpu.envs.atari import AtariEnv
+
+    with pytest.raises(ImportError, match="ale_py"):
+        AtariEnv(EnvParams(env_type="atari", game="pong"), 0)
