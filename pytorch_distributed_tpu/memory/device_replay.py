@@ -202,7 +202,14 @@ class DeviceReplay:
 
     def restore(self, data: dict) -> int:
         """Refill via the normal chunked write path (works across capacity
-        changes, keeps the newest rows that fit).  Returns rows restored."""
+        changes, keeps the newest rows that fit).  Returns rows restored.
+
+        Replaces any existing contents — the ring is re-initialised first so
+        restore has the same overwrite-[:n] semantics as the host-side
+        replays (SharedReplay/PrioritizedReplay) rather than appending at
+        the current cursor."""
+        if self.size:
+            self.state = self._init_state()
         rows = np.asarray(data["reward"])
         n = min(len(rows), self.capacity)
         if n:
@@ -290,6 +297,8 @@ class DeviceReplayIngest:
         if self._pending:
             # sub-chunk remainder: the drain cadence leaves rows below the
             # smallest preset chunk size pending; a checkpoint must not
+            # lose them, so flush the remainder as one odd-sized chunk
+            # (costs a single extra jit trace).
             from pytorch_distributed_tpu.utils.experience import (
                 transition_dtypes,
             )

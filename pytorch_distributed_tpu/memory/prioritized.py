@@ -133,6 +133,9 @@ class PrioritizedReplay(Memory):
         # PER converts its p^alpha running max to base on snapshot too
         out["max_priority_base"] = np.float64(self.max_priority)
         out["samples_drawn"] = np.int64(self._samples_drawn)
+        # The exponent the leaves are saved under, so a restoring run with a
+        # different priority_exponent can convert instead of mixing units.
+        out["alpha"] = np.float64(self.alpha)
         return out
 
     def restore(self, data: dict) -> None:
@@ -143,11 +146,26 @@ class PrioritizedReplay(Memory):
         if "leaf_priority" in data:
             leaves = np.asarray(data["leaf_priority"],
                                 dtype=np.float64)[-n:]
+            # Leaves are saved p^alpha under the SAVING run's alpha; if the
+            # restoring run uses a different exponent, re-exponentiate so
+            # restored and freshly-fed priorities share one unit.
+            saved_alpha = float(data.get("alpha", self.alpha))
+            if saved_alpha != self.alpha and saved_alpha > 0:
+                leaves = leaves ** (self.alpha / saved_alpha)
         else:  # snapshot from a uniform ring: everything replays once
             leaves = np.full(n, self._priority(None), dtype=np.float64)
         idx = np.arange(n)
         self.sum_tree.set(idx, leaves)
         self.min_tree.set(idx, leaves)
+        if n < self.capacity:
+            # Zero any leaves beyond the restored region so a snapshot
+            # smaller than the current contents can't leave stale
+            # priorities pointing at pre-restore rows.
+            stale = np.arange(n, self.capacity)
+            self.sum_tree.set(stale, np.zeros(len(stale)))
+            # MinTree's neutral is +inf (segment_tree.py:116): zeros here
+            # would drive min_prob to 0 and every IS weight to 0.
+            self.min_tree.set(stale, np.full(len(stale), np.inf))
         self._pos = n % self.capacity
         self._full = n == self.capacity
         self.max_priority = float(data.get("max_priority_base", 1.0))

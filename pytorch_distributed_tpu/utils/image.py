@@ -7,6 +7,11 @@ this image ships no cv2, so the resize is first-party: a C++ kernel
 Convention (both paths): pixel-center alignment — the source coordinate of
 output pixel i is ``(i + 0.5) * (in/out) - 0.5`` clamped into the source —
 interpolated in float64 and rounded half-up to uint8.
+
+"Bit-identical" applies to the C++-vs-numpy pair only.  cv2's uint8 path
+interpolates in 11-bit fixed point, so outputs may differ from real
+cv2.INTER_LINEAR by ±1 LSB — parity tests against cv2-produced frames
+must use a tolerance of 1.
 """
 
 from __future__ import annotations

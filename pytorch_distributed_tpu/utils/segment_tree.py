@@ -5,8 +5,9 @@ The reference ships a dead, import-crashing sum-tree sketch
 never imported; PER is a TODO at reference utils/options.py:82).  This module
 is the finished version: a flat-array binary sum tree with vectorized batch
 operations (set/sample-many at once, numpy), plus a min tree for computing
-max importance-sampling weights.  A device-side (JAX) prefix-sum sampler for
-the HBM-resident replay lives in ``ops/per_sample.py``.
+max importance-sampling weights.  The device-side (JAX) prioritized sampler
+for the HBM-resident replay lives in ``ops/pallas_sampling.py`` (used by
+``memory/device_per.py``).
 """
 
 from __future__ import annotations
