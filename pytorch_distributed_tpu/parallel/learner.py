@@ -27,7 +27,10 @@ from pytorch_distributed_tpu.utils.experience import Batch
 
 class ShardedLearner:
     def __init__(self, step_fn: Callable, mesh: Optional[jax.sharding.Mesh],
-                 donate: bool = True):
+                 donate: bool = True, state_shardings=None):
+        """``state_shardings``: optional NamedSharding pytree matching the
+        TrainState — e.g. parallel/tensor_parallel.dtqn_state_shardings for
+        a Megatron-split FFN over mp.  Default replicates the state."""
         self.mesh = mesh
         self._serialize_collectives = (
             mesh is not None
@@ -39,13 +42,16 @@ class ShardedLearner:
             self._batch_sharding = None
         else:
             self._batch_sharding = batch_sharding(mesh)
-            self._state_sharding = replicated(mesh)
-            # Replicated state + dp-sharded batch; XLA lowers the gradient
-            # reduction to an ICI all-reduce automatically.
+            self._state_sharding = (replicated(mesh)
+                                    if state_shardings is None
+                                    else state_shardings)
+            # dp-sharded batch + (replicated | tensor-sharded) state; XLA
+            # lowers the gradient reduction to an ICI all-reduce (plus the
+            # mp psums when FFN kernels are split) automatically.
             self._step = jax.jit(
                 step_fn,
                 in_shardings=(self._state_sharding, self._batch_sharding),
-                out_shardings=(self._state_sharding, self._state_sharding,
+                out_shardings=(self._state_sharding, replicated(mesh),
                                self._batch_sharding),
                 donate_argnums=(0,) if donate else (),
             )

@@ -8,8 +8,9 @@ chips with two axes —
 - ``dp`` (data parallel): carries the learner batch; gradients are
   all-reduced across it over ICI (XLA inserts the collective when the batch
   is dp-sharded and params are replicated);
-- ``mp`` (model parallel): reserved for tensor-sharded layers on models wide
-  enough to pay for it; size 1 in all current configs.
+- ``mp`` (model parallel): tensor-sharded layers on models wide enough to
+  pay for it — the DTQN FFN is Megatron-split over this axis when
+  ``mp_size > 1`` (parallel/tensor_parallel.py).
 
 Multi-host pods: call ``jax.distributed.initialize`` first
 (``init_multihost``), then the same mesh code spans all hosts' devices —

@@ -1,0 +1,68 @@
+"""Tensor parallelism: Megatron-style FFN sharding over the mesh ``mp`` axis.
+
+No reference equivalent (the reference is single-GPU; SURVEY.md §2
+"parallelism strategies" lists tensor parallelism as NOT present there) —
+this is the TPU-native capability that makes the mesh's ``mp`` axis real
+for the one model family wide enough to use it: the DTQN transformer
+(models/dtqn.py).
+
+Design: sharding annotations only, no manual collectives.  Each block's
+FFN expand kernel (``Dense_2``, dim -> 4*dim) is column-sharded over mp and
+its contract kernel (``Dense_3``, 4*dim -> dim) is row-sharded; everything
+else (attention, embeddings, heads, optimizer scalars) replicates.  Under
+``jit`` XLA's SPMD partitioner then runs each FFN matmul on 1/mp of the
+hidden dim per chip and inserts the one all-reduce (psum over mp, on ICI)
+at the contract output — the standard Megatron dataflow, expressed the JAX
+way.  Because the Adam moments mirror the param tree, the same
+path-suffix rule shards them identically, so optimizer memory for the FFN
+also drops by 1/mp per chip.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# flax auto-names the four Dense calls in models/dtqn.py::_Block in call
+# order: Dense_0 = qkv, Dense_1 = attention out-proj, Dense_2 = FFN
+# expand, Dense_3 = FFN contract.
+_FFN_EXPAND, _FFN_CONTRACT = "Dense_2", "Dense_3"
+
+
+def _path_strings(path) -> list:
+    out = []
+    for p in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                out.append(str(getattr(p, attr)))
+                break
+        else:
+            out.append(str(p))
+    return out
+
+
+def _spec_for_path(path) -> P:
+    keys = _path_strings(path)
+    for i, k in enumerate(keys):
+        if not k.startswith("_Block_"):
+            continue
+        tail = keys[i + 1:]
+        if _FFN_EXPAND in tail:
+            # kernel (dim, 4*dim): split the output features; its bias
+            # (4*dim,) follows the same split
+            return P(None, "mp") if tail[-1] == "kernel" else P("mp")
+        if _FFN_CONTRACT in tail:
+            # kernel (4*dim, dim): split the contraction dim — XLA closes
+            # it with a psum over mp; bias (dim,) stays replicated
+            return P("mp", None) if tail[-1] == "kernel" else P()
+    return P()
+
+
+def dtqn_state_shardings(state: Any, mesh: Mesh) -> Any:
+    """A NamedSharding pytree for a DTQN TrainState (params, target params
+    and Adam moments all share the param paths, so one suffix rule shards
+    all three); pass to ``ShardedLearner(state_shardings=...)``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _spec_for_path(path)), state)
