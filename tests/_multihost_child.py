@@ -1,0 +1,59 @@
+"""Child process for tests/test_multihost.py: joins a 2-process
+jax.distributed CPU cluster via parallel/mesh.init_multihost, builds the
+global mesh, and runs one cross-process reduction.
+
+Run: python _multihost_child.py <coordinator> <num_processes> <process_id>
+Prints MULTIHOST_OK <total> on success.  Must configure platform before
+first jax use (this image's sitecustomize pre-imports jax pinned to a
+hardware platform)."""
+
+import os
+import re
+import sys
+
+
+def main() -> None:
+    coordinator, num_processes, process_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_distributed_tpu.parallel.mesh import init_multihost, make_mesh
+
+    init_multihost(coordinator_address=coordinator,
+                   num_processes=num_processes, process_id=process_id)
+
+    assert jax.process_index() == process_id
+    assert len(jax.local_devices()) == 2
+    assert jax.device_count() == 2 * num_processes, jax.device_count()
+
+    # the same mesh code a pod uses, now spanning both processes' devices
+    mesh = make_mesh(dp_size=2 * num_processes)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # each process contributes rows valued (process_id + 1); the jitted
+    # sum over the dp-sharded global array forces a cross-process
+    # all-reduce through the distributed runtime
+    local = np.full((2, 3), float(process_id + 1), np.float32)
+    arr = multihost_utils.host_local_array_to_global_array(
+        local, mesh, P("dp"))
+    total = jax.jit(jnp.sum,
+                    out_shardings=NamedSharding(mesh, P()))(arr)
+    expected = 3.0 * 2 * sum(range(1, num_processes + 1))
+    np.testing.assert_allclose(float(total), expected)
+    multihost_utils.sync_global_devices("test_done")
+    print(f"MULTIHOST_OK {float(total)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

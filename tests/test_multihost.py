@@ -1,0 +1,55 @@
+"""init_multihost (parallel/mesh.py): the learner-spans-hosts path.
+
+A real 2-process jax.distributed cluster on the CPU backend — the same
+``jax.distributed.initialize`` call a TPU pod makes (there: one process
+per host, coordinator on host 0), validated end-to-end: cluster formation,
+global device visibility, the standard mesh over all processes' devices,
+and one jitted cross-process reduction.  SURVEY.md §5 "distributed
+communication backend"."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_multihost_child.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_init_multihost_two_process_cpu_cluster():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # append, never overwrite: the default PYTHONPATH carries the
+    # hardware-platform plugin site dir
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _CHILD, coordinator, "2", str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"process {pid} exited {p.returncode}:\n{out[-3000:]}")
+        assert "MULTIHOST_OK 18.0" in out, out[-3000:]
