@@ -76,9 +76,11 @@ def greedy_episodes(opt: Options, spec: EnvSpec, model, params, env,
     for _ in range(nepisodes):
         on_reset()
         obs = env.reset()
+        env.render()  # no-op unless a FrameDumper is attached
         ep_reward, ep_steps, terminal, info = 0.0, 0, False, {}
         while not terminal:
             obs, r, terminal, info = env.step(pick(obs))
+            env.render()
             ep_reward += float(r)
             ep_steps += 1
         total_steps += ep_steps
@@ -96,6 +98,10 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     fleet = opt.num_actors * max(1, opt.env_params.num_envs_per_actor)
     env = build_env(opt, process_ind=fleet + 1)
     env.eval()  # standard episode boundaries (reference evaluators.py:19)
+    if opt.env_params.render:
+        from pytorch_distributed_tpu.utils.render import attach_frame_dumper
+
+        attach_frame_dumper(env, opt.log_dir, "evaluator")
     model = build_model(opt, spec)
     params0 = init_params(opt, spec, model, seed=process_seed(
         opt.seed, "evaluator"))

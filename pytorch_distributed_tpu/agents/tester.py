@@ -25,6 +25,10 @@ def run_tester(opt: Options, spec: EnvSpec) -> Dict[str, float]:
     ap = opt.agent_params
     env = build_env(opt, process_ind=0)
     env.eval()
+    if opt.env_params.render:
+        from pytorch_distributed_tpu.utils.render import attach_frame_dumper
+
+        attach_frame_dumper(env, opt.log_dir, "tester")
     model = build_model(opt, spec)
     template = init_params(opt, spec, model,
                            seed=process_seed(opt.seed, "tester"))

@@ -66,6 +66,8 @@ class Env:
         # (reference core/envs/atari_env.py:66-68 / core/model.py).
         self.norm_val: float = 1.0
         self._episode_steps = 0
+        self.last_obs: Any = None
+        self._renderer = None
 
     # -- mode switches (reference core/env.py:29-35) ------------------------
 
@@ -79,7 +81,11 @@ class Env:
 
     def reset(self) -> np.ndarray:
         self._episode_steps = 0
-        return self._reset()
+        obs = self._reset()
+        self.last_obs = obs
+        if self._renderer is not None:
+            self._renderer.new_episode()
+        return obs
 
     def step(self, action) -> Tuple[np.ndarray, float, bool, Dict[str, Any]]:
         obs, reward, terminal, info = self._step(action)
@@ -87,10 +93,19 @@ class Env:
         if self.params.early_stop and self._episode_steps >= self.params.early_stop:
             terminal = True
             info.setdefault("truncated", True)
+        self.last_obs = obs
         return obs, reward, terminal, info
 
-    def render(self) -> None:  # reference core/env.py:51 (optional)
-        pass
+    def attach_renderer(self, dumper) -> None:
+        """Route ``render()`` frames to a utils/render.FrameDumper."""
+        self._renderer = dumper
+
+    def render(self) -> None:
+        """Dump the newest frame through the attached renderer.  The
+        reference displayed frames live via cv2.imshow (reference
+        core/env.py:51-76); headless equivalent: PNG dump per step."""
+        if self._renderer is not None and self.last_obs is not None:
+            self._renderer.add(self.last_obs)
 
     # -- to implement -------------------------------------------------------
 

@@ -1,0 +1,73 @@
+"""Headless rendering: dump env frames to PNG files.
+
+The reference displays live frames with ``cv2.imshow`` during evaluation
+(reference core/env.py:51-76, core/envs/atari_env.py:83); this image is
+headless and ships no cv2, so the equivalent capability is a frame dump —
+attach a ``FrameDumper`` to any env (``env.attach_renderer``) and each
+``env.render()`` call writes the newest observation frame as a PNG under
+``<dir>/ep<episode>/step<t>.png``.  Enabled by the ``--render`` CLI flag
+in mode 2 (tester) and by ``env_params.render`` generally.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def frame_image(obs: np.ndarray) -> Optional[np.ndarray]:
+    """Newest displayable (H, W) or (H, W, 3) uint8 frame in an
+    observation, or None for non-image observations (low-dim vectors)."""
+    obs = np.asarray(obs)
+    if obs.dtype != np.uint8:
+        return None
+    if obs.ndim == 2:
+        return obs
+    if obs.ndim == 3:
+        if obs.shape[-1] == 3:  # already (H, W, RGB)
+            return obs
+        return obs[-1]  # (C, H, W) frame stack: newest frame last
+    return None
+
+
+def attach_frame_dumper(env, log_dir: str, role: str) -> str:
+    """Wire a FrameDumper under ``<log_dir>/frames`` onto ``env`` and
+    announce it — the shared attach used by the tester (mode 2) and the
+    mode-1 evaluator."""
+    frames_dir = os.path.join(log_dir, "frames")
+    env.attach_renderer(FrameDumper(frames_dir))
+    print(f"[{role}] rendering eval frames to {frames_dir}")
+    return frames_dir
+
+
+class FrameDumper:
+    def __init__(self, root: str):
+        self.root = root
+        self.episode = -1
+        self.t = 0
+        os.makedirs(root, exist_ok=True)
+
+    def new_episode(self) -> None:
+        self.episode += 1
+        self.t = 0
+        os.makedirs(self._ep_dir(), exist_ok=True)
+
+    def _ep_dir(self) -> str:
+        return os.path.join(self.root, f"ep{self.episode:03d}")
+
+    def add(self, obs: np.ndarray) -> Optional[str]:
+        """Write the observation's newest frame; returns the path (None
+        for non-image observations)."""
+        img = frame_image(obs)
+        if img is None:
+            return None
+        if self.episode < 0:
+            self.new_episode()
+        from PIL import Image
+
+        path = os.path.join(self._ep_dir(), f"step{self.t:05d}.png")
+        Image.fromarray(img).save(path)
+        self.t += 1
+        return path

@@ -1,0 +1,53 @@
+"""Headless render path (utils/render.py): frame extraction + PNG dumps
+through the env render() surface — the capability standing in for the
+reference's cv2.imshow display (reference core/env.py:51-76)."""
+
+import glob
+import os
+
+import numpy as np
+
+from pytorch_distributed_tpu.config import build_options
+from pytorch_distributed_tpu.utils.render import FrameDumper, frame_image
+
+
+def test_frame_image_shapes():
+    stack = np.arange(4 * 8 * 8, dtype=np.uint8).reshape(4, 8, 8)
+    np.testing.assert_array_equal(frame_image(stack), stack[-1])
+    gray = stack[0]
+    np.testing.assert_array_equal(frame_image(gray), gray)
+    rgb = np.zeros((8, 8, 3), np.uint8)
+    assert frame_image(rgb).shape == (8, 8, 3)
+    assert frame_image(np.zeros(6, np.float32)) is None  # low-dim obs
+
+
+def test_pong_sim_render_dumps_pngs(tmp_path):
+    from pytorch_distributed_tpu.envs.pong_sim import PongSimEnv
+
+    opt = build_options(4)
+    env = PongSimEnv(opt.env_params, process_ind=0)
+    env.attach_renderer(FrameDumper(str(tmp_path)))
+    env.reset()
+    env.render()
+    for a in (2, 3, 0):
+        env.step(a)
+        env.render()
+    ep0 = sorted(glob.glob(os.path.join(str(tmp_path), "ep000", "*.png")))
+    assert len(ep0) == 4
+    from PIL import Image
+
+    img = np.asarray(Image.open(ep0[-1]))
+    assert img.shape == (84, 84) and img.dtype == np.uint8
+    # a second episode lands in its own directory
+    env.reset()
+    env.render()
+    assert glob.glob(os.path.join(str(tmp_path), "ep001", "*.png"))
+
+
+def test_render_is_noop_without_renderer(tmp_path):
+    from pytorch_distributed_tpu.envs.fake_env import FakeChainEnv
+
+    opt = build_options(1)
+    env = FakeChainEnv(opt.env_params, process_ind=0)
+    env.reset()
+    env.render()  # must not raise or write anything
