@@ -44,6 +44,10 @@ def parse_args(argv=None):
     p.add_argument("--backend", choices=("process", "thread"),
                    default="process")
     p.add_argument("--no-tensorboard", action="store_true")
+    p.add_argument("--render", action="store_true",
+                   help="dump eval frames (tester in mode 2, evaluator in "
+                        "mode 1) as PNGs under the run's log dir (headless "
+                        "stand-in for the reference's cv2.imshow display)")
     p.add_argument("--dp-size", type=int, default=-1,
                    help="learner mesh data-parallel width (-1 = all devices)")
     p.add_argument("--set", action="append", default=[], metavar="K=V",
@@ -78,6 +82,8 @@ def options_from_args(args):
         overrides["model_file"] = args.model_file
     if args.no_tensorboard:
         overrides["visualize"] = False
+    if args.render:
+        overrides["render"] = True
     if args.dp_size != -1:
         overrides["dp_size"] = args.dp_size
     return build_options(config=args.config, **overrides)
@@ -86,6 +92,11 @@ def options_from_args(args):
 def main(argv=None):
     args = parse_args(argv)
     opt = options_from_args(args)
+
+    from pytorch_distributed_tpu.utils.helpers import enable_compile_cache
+
+    enable_compile_cache()
+
     from pytorch_distributed_tpu import runtime
 
     if opt.mode == 1:

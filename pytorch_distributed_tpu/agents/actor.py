@@ -75,8 +75,17 @@ class _ActorHarness:
         _, self.unravel = make_flattener(params0)
         # block until the learner publishes the initial weights — the
         # explicit version of the reference's pre-spawn hard sync
-        # (reference dqn_actor.py:26-30)
-        flat, self.version = param_store.wait(0, stop=clock.stop)
+        # (reference dqn_actor.py:26-30).  Generous timeout: the first
+        # publication sits behind the learner process's remote XLA
+        # compiles, which can take minutes on a tunnelled chip; a dead
+        # learner is caught by the stop event, not this timeout.
+        flat, self.version = param_store.wait(0, timeout=300.0,
+                                              stop=clock.stop)
+        if hasattr(memory, "set_stop"):
+            # stop-aware feeding: a flush blocked on a full queue after
+            # the learner stopped draining must abort, not deadlock the
+            # teardown join
+            memory.set_stop(clock.stop)
         # rollout inference is pinned to the host CPU: the learner owns
         # the accelerator; batch-1/small-batch forwards must not round-trip
         # a (possibly tunnelled) chip (utils/helpers.py pin_to_cpu)
@@ -231,6 +240,10 @@ class _ActorHarness:
         self.flush_stats()
         if hasattr(self.memory, "flush"):
             self.memory.flush()
+        from pytorch_distributed_tpu.memory.feeder import QueueFeeder
+
+        if isinstance(self.memory, QueueFeeder):
+            self.memory.close()
         self._timing_writer.close()
 
 

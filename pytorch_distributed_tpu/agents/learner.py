@@ -76,7 +76,20 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         params = ckpt.load_params(path, params)
     state, step_fn = build_train_state_and_step(opt, spec, model, params,
                                                 mesh=mesh)
-    learner = ShardedLearner(step_fn, mesh, donate=pp.donate)
+    state_shardings = None
+    if mesh is not None and pp.mp_size > 1:
+        # the one family wide enough for tensor parallelism: Megatron-split
+        # DTQN FFN over mp (parallel/tensor_parallel.py)
+        assert "dtqn" in opt.model_type, (
+            f"mp_size>1 is only supported for dtqn models "
+            f"(got {opt.model_type})")
+        from pytorch_distributed_tpu.parallel.tensor_parallel import (
+            dtqn_state_shardings,
+        )
+
+        state_shardings = dtqn_state_shardings(state, mesh)
+    learner = ShardedLearner(step_fn, mesh, donate=pp.donate,
+                             state_shardings=state_shardings)
     state = learner.place(state)
 
     # resume full state if a prior run left one (the resume tier the
@@ -222,7 +235,10 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     # spin forever since a full ring's size never exceeds its capacity
     cap = getattr(memory, "capacity", opt.memory_params.memory_size)
     learn_start = min(ap.learn_start, cap - 1)
-    while not clock.done(ap.steps) and memory_size(memory) <= learn_start:
+    deadline = (time.monotonic() + ap.max_seconds) if ap.max_seconds > 0 \
+        else float("inf")
+    while not clock.done(ap.steps) and memory_size(memory) <= learn_start \
+            and time.monotonic() < deadline:
         time.sleep(0.05)
 
     # the latest step's metric refs, fetched to host only on the
@@ -236,7 +252,8 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     # atomic line writes; the logger process keeps the aggregated scalars)
     timing_writer = MetricsWriter(opt.log_dir, enable_tensorboard=False)
 
-    while lstep < ap.steps and not clock.stop.is_set():
+    while lstep < ap.steps and not clock.stop.is_set() \
+            and time.monotonic() < deadline:
         if ap.max_replay_ratio > 0:
             # pacing gate: don't draw more than max_replay_ratio samples
             # per collected transition (config.py AgentParams docstring).
@@ -246,6 +263,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             # keep draining while throttled — a full ingest queue blocks
             # actors before they can advance the clock (deadlock).
             while (not clock.stop.is_set()
+                   and time.monotonic() < deadline
                    and (lstep - lstep0 + 1) * ap.batch_size
                    > ap.max_replay_ratio * max(clock.actor_step.value, 1)):
                 if hasattr(memory, "drain"):

@@ -152,6 +152,11 @@ class AgentParams:
     agent_type: str = "dqn"
     # --- generic (reference :117-127 / :146-156) ---
     steps: int = 500000                # max learner steps
+    # Wall-clock budget for the run, seconds; 0 = unlimited.  When it
+    # expires the learner ends the run exactly as if ``steps`` was reached
+    # (final checkpoint, clean join).  Used by time-boxed benches/drives;
+    # no reference equivalent (runs there end on steps only).
+    max_seconds: float = 0.0
     gamma: float = 0.99
     clip_grad: float = float("inf")    # dqn: inf; ddpg: 40.0
     lr: float = 1e-4

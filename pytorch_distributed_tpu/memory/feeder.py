@@ -32,12 +32,41 @@ class QueueFeeder:
         self._q = q
         self._chunk = chunk
         self._buf: List[Tuple[Transition, Optional[float]]] = []
+        self._stop = None
+        self._timeout_put = False
 
     def clone(self) -> "QueueFeeder":
         """Same queue, fresh chunk buffer — thread-backend workers each get
         their own clone so the buffer is never shared across threads (the
         process backend gets per-child copies from pickling anyway)."""
-        return QueueFeeder(self._q, self._chunk)
+        f = QueueFeeder(self._q, self._chunk)
+        if self._stop is not None:
+            f.set_stop(self._stop)
+        return f
+
+    def set_stop(self, event) -> None:
+        """Make flush() abort (dropping its buffer) once ``event`` is set:
+        with the learner gone nobody drains the queue, and a put() blocked
+        on the full pipe would stall the worker past the teardown join."""
+        self._stop = event
+        # The stop-aware branch needs put(timeout=...); duck-typed sinks
+        # without it (e.g. the DCN fleet's _ChunkSink, whose put is its
+        # own non-blocking send) keep the plain call.
+        import inspect
+
+        try:
+            self._timeout_put = (
+                "timeout" in inspect.signature(self._q.put).parameters)
+        except (ValueError, TypeError):
+            self._timeout_put = False
+
+    def close(self) -> None:
+        """Never block process exit on the mp queue's feeder thread: its
+        buffered chunks can't flush into a full pipe once the learner
+        stopped draining, and the default join-at-exit would hang the
+        worker until the supervisor's terminate."""
+        if hasattr(self._q, "cancel_join_thread"):
+            self._q.cancel_join_thread()
 
     def feed(self, transition: Transition,
              priority: Optional[float] = None) -> None:
@@ -46,9 +75,20 @@ class QueueFeeder:
             self.flush()
 
     def flush(self) -> None:
-        if self._buf:
+        if not self._buf:
+            return
+        if self._stop is None or not self._timeout_put:
             self._q.put(self._buf)
-            self._buf = []
+        else:
+            while True:
+                if self._stop.is_set():
+                    break  # shutdown: leftover experience is garbage
+                try:
+                    self._q.put(self._buf, timeout=0.2)
+                    break
+                except _queue.Full:
+                    continue
+        self._buf = []
 
 
 def pop_chunks(q, max_chunks: int = 1024) -> List[Tuple[Transition,

@@ -90,9 +90,14 @@ class Topology:
             specs.append(("actor", i, (
                 opt, spec, i, side, self.param_store,
                 self.clock, self.actor_stats)))
-        specs.append(("evaluator", 0, (
-            opt, spec, 0, None, self.param_store, self.clock,
-            self.evaluator_stats)))
+        if opt.agent_params.evaluator_nepisodes > 0:
+            specs.append(("evaluator", 0, (
+                opt, spec, 0, None, self.param_store, self.clock,
+                self.evaluator_stats)))
+        else:
+            # no evaluator (time-boxed benches): mark its handshake done so
+            # the logger's end-of-run drain doesn't wait the 60 s grace
+            self.evaluator_stats.done.value = 1
         return specs
 
     # -- run ---------------------------------------------------------------
@@ -199,9 +204,15 @@ class Topology:
         # take minutes on a saturated host, and a thread-backend worker
         # abandoned at interpreter exit aborts the process from C++
         # teardown — waiting is the safe side
-        deadline = time.monotonic() + timeout
+        t0 = time.monotonic()
+        deadline = t0 + timeout
         for w in self._workers:
             w.join(max(0.1, deadline - time.monotonic()))
+        if time.monotonic() - t0 > 30.0:
+            slow = [w.name for w in self._workers
+                    if (w.is_alive() if hasattr(w, "is_alive") else False)]
+            print(f"[runtime] join took {time.monotonic() - t0:.0f}s; "
+                  f"still alive: {slow or 'none'}")
         for w in self._workers:
             if isinstance(w, _CTX.Process) and w.is_alive():
                 w.terminate()

@@ -50,6 +50,26 @@ def update_target(target: PyTree, online: PyTree, step: jnp.ndarray,
     return periodic_update(target, online, step, int(target_model_update))
 
 
+def enable_compile_cache(cache_dir: str | None = None) -> str:
+    """Turn on JAX's persistent XLA compile cache for this process AND its
+    spawned workers.
+
+    Both halves are load-bearing: ``jax.config.update`` flips the already-
+    imported jax in this process (the env var alone is too late once
+    sitecustomize pre-imported jax), while the env var is inherited by
+    spawn children whose fresh jax import reads it.  Repeated drives on a
+    tunnelled chip otherwise pay minutes of identical remote compiles per
+    process."""
+    import os
+    import tempfile
+
+    cache_dir = os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        cache_dir or os.path.join(tempfile.gettempdir(), "pdtpu_xla_cache"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    return cache_dir
+
+
 def host_cpu_device():
     """The host CPU jax device — always present alongside any accelerator
     backend."""
