@@ -22,5 +22,51 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent XLA compile cache: the suite's expensive compiles (ring/
+# Ulysses shard_map programs, CNN train steps) are identical across runs;
+# caching them cuts several minutes off every rerun.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/pdtpu_xla_cache_tests")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import signal  # noqa: E402
+
+import pytest  # noqa: E402
+
+# Per-test wall-clock backstop (SIGALRM; pytest-timeout is not in this
+# image).  Unmarked tests get DEFAULT_TIMEOUT; long end-to-end tests carry
+# @pytest.mark.slow plus an explicit @pytest.mark.timeout(n).  The fast
+# tier is `pytest -m "not slow"`.  Note the alarm can only interrupt the
+# main thread between bytecodes: a test stuck inside one long C call
+# (e.g. an XLA compile) overshoots until that call returns.
+DEFAULT_TIMEOUT = 300
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: end-to-end/learning test excluded from the fast "
+        "tier (run with -m slow or no -m filter)")
+    config.addinivalue_line(
+        "markers", "timeout(seconds): per-test wall-clock limit "
+        "(default %d)" % DEFAULT_TIMEOUT)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("timeout")
+    limit = int(marker.args[0]) if marker else DEFAULT_TIMEOUT
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} exceeded the {limit}s wall-clock limit")
+
+    old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(limit)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_handler)

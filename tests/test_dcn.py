@@ -142,6 +142,8 @@ class TestGateway:
 
 
 class TestFleetEndToEnd:
+    @pytest.mark.slow
+    @pytest.mark.timeout(900)
     def test_remote_actors_train_over_localhost(self, tmp_path):
         """Learner host (thread backend, 0 local actors) + 2 remote actors
         on localhost: the full Ape-X loop with every shared-plane mechanism

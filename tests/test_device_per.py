@@ -4,6 +4,7 @@ write-back."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from pytorch_distributed_tpu.memory.device_per import (
     DevicePerReplay, PerReplayState, per_sample, per_update_priorities,
@@ -94,6 +95,8 @@ def test_fused_step_trains_and_writes_back():
     assert not np.allclose(np.asarray(rs2.priority), pr_before)
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(300)
 def test_multi_step_dispatch_per_topology(tmp_path):
     from pytorch_distributed_tpu import runtime
     from pytorch_distributed_tpu.config import build_options

@@ -86,6 +86,8 @@ def _writer(mem, start, n):
         mem.feed(_tr(i, (8,)))
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(240)
 def test_multiprocess_hammer():
     """4 writer processes + concurrent reader: every sampled row must be a
     consistent snapshot (reward == state0[0], state1 == state0+1)."""

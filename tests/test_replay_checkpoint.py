@@ -161,6 +161,8 @@ def test_missing_or_unsupported(tmp_path):
     assert np.isfinite(batch.weight).all()
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(600)
 def test_topology_resume_with_warm_replay(tmp_path):
     """End to end: run, stop, resume — the second run starts with the first
     run's replay AND train state (learner step continues)."""

@@ -110,6 +110,8 @@ class TestDtqnModel:
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(1200)
 def test_dtqn_sequence_parallel_learner_runs(tmp_path):
     """The sp>1 path end to end: a dp2 x sp4 mesh, DTQN's attention swapped
     for ring attention inside the jitted train step, short topology run."""
@@ -125,6 +127,8 @@ def test_dtqn_sequence_parallel_learner_runs(tmp_path):
     assert topo.clock.learner_step.value >= 40
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(1200)
 def test_dtqn_chain_topology_learns(tmp_path):
     from pytorch_distributed_tpu import runtime
     from pytorch_distributed_tpu.config import build_options

@@ -262,6 +262,8 @@ class TestRecurrentModel:
                                    np.asarray(q_explicit))
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(1200)
 def test_r2d2_chain_topology_learns(tmp_path):
     from pytorch_distributed_tpu import runtime
     from pytorch_distributed_tpu.config import build_options

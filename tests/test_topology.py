@@ -40,6 +40,8 @@ def _opts(tmp_path, config, **overrides):
     return build_options(config=config, **base)
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(240)
 def test_dqn_chain_topology_trains_and_checkpoints(tmp_path):
     # early_stop 25 < learn_start/num_actors: every env slot truncates an
     # episode during replay warmup, before the learner can finish
@@ -70,6 +72,8 @@ def test_dqn_chain_topology_trains_and_checkpoints(tmp_path):
     assert out["avg_steps"] > 0
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(600)
 def test_dqn_chain_learns_optimal_policy(tmp_path):
     # longer run: greedy policy should walk straight down the chain.
     # max_replay_ratio pins the learner/actor pace so the outcome doesn't
@@ -91,6 +95,8 @@ def test_dqn_chain_learns_optimal_policy(tmp_path):
     assert out["avg_steps"] <= 10
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(300)
 def test_ddpg_pendulum_topology_runs(tmp_path):
     opt = _opts(tmp_path, config=2, steps=200, learn_start=64,
                 batch_size=32)
@@ -102,6 +108,8 @@ def test_ddpg_pendulum_topology_runs(tmp_path):
     assert os.path.exists(opt.model_name + ".msgpack")
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(300)
 def test_per_topology_runs_and_anneals(tmp_path):
     opt = _opts(tmp_path, config=1, memory_type="prioritized", steps=200)
     topo = runtime.train(opt, backend="thread")
@@ -113,6 +121,8 @@ def test_per_topology_runs_and_anneals(tmp_path):
     assert len(np.unique(np.round(pr, 6))) > 1
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(300)
 def test_resume_from_full_state(tmp_path):
     opt = _opts(tmp_path, config=1, steps=100)
     runtime.train(opt, backend="thread")
@@ -123,6 +133,8 @@ def test_resume_from_full_state(tmp_path):
     assert topo2.clock.learner_step.value >= 150
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(300)
 def test_device_replay_topology_runs(tmp_path):
     # flagship HBM-replay path on the fake env (config 8 is pong-sim; use
     # the same memory_type over the cheap chain env for CI speed)
@@ -134,6 +146,8 @@ def test_device_replay_topology_runs(tmp_path):
     assert topo.handles.learner_side.size > 0
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(300)
 def test_native_ring_topology_runs(tmp_path):
     pytest.importorskip("ctypes")
     try:
@@ -149,6 +163,8 @@ def test_native_ring_topology_runs(tmp_path):
     assert topo.handles.learner_side.total_feeds > 0
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(300)
 def test_ddpg_reacher_multidim_topology_runs(tmp_path):
     """The 2-dim continuous action path end to end: OU noise shaped
     (num_envs, 2), decoupled two-optimizer DDPG update, tester reload."""
@@ -166,6 +182,8 @@ def test_ddpg_reacher_multidim_topology_runs(tmp_path):
     assert out["avg_reward"] < 0.0  # negative-cost env; sanity only
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(240)
 def test_vector_env_actor_topology(tmp_path):
     # early_stop 12 < learn_start/4 envs: all four env slots truncate an
     # episode during replay warmup regardless of scheduling
@@ -179,6 +197,8 @@ def test_vector_env_actor_topology(tmp_path):
     assert any(r["tag"] == "actor/avg_reward" for r in recs)
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(900)
 def test_actor_crash_restarts_elastically(tmp_path):
     """Failure supervision: a dying actor child is respawned in place and
     the run completes (process backend)."""
@@ -213,6 +233,8 @@ def test_actor_crash_restarts_elastically(tmp_path):
     assert len(topo._proc_meta) >= 3
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(240)
 def test_device_per_topology_runs(tmp_path):
     opt = _opts(tmp_path, config=1, memory_type="device-per", steps=200)
     topo = runtime.train(opt, backend="thread")

@@ -66,6 +66,8 @@ def test_dtqn_window_q_matches_local():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(1200)
 def test_dtqn_ulysses_learner_runs(tmp_path):
     """The sp>1 Ulysses path end to end: dp2 x sp4 mesh, DTQN attention
     swapped for the all-to-all, short topology run."""
