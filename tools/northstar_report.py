@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Produce the north-star artifact (RESULTS.json) from a training run.
+
+Reads a run's ``scalars.jsonl`` and computes the BASELINE.md acceptance
+numbers for "Distributed DQN reaches 18.0 mean eval reward on TPU":
+
+- wall-clock (and learner steps) to the first eval >= threshold,
+- env frames/sec/chip over the full run (agent steps; x4 emulated
+  frames, reference core/envs/atari_env.py:95) — the accounting of
+  reference core/single_processes/dqn_logger.py:42,
+- learner updates/sec (median of logger windows),
+- the full eval-reward curve for the record.
+
+Usage:
+    python tools/northstar_report.py <log_dir> [--threshold 18] \
+        [--out RESULTS.json] [--meta k=v ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def load(log_dir: str):
+    path = os.path.join(log_dir, "scalars.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def series(rows, tag):
+    """(wall, value, learner_step) triples for one tag (every scalar
+    record carries the learner step as its x-axis, utils/metrics.py)."""
+    return [(r["wall"], r["value"], r.get("step", 0)) for r in rows
+            if r["tag"] == tag]
+
+
+def report(log_dir: str, threshold: float, n_chips: int = 1) -> dict:
+    rows = load(log_dir)
+    t0 = min(r["wall"] for r in rows)
+    evals = series(rows, "evaluator/avg_reward")
+    frames = series(rows, "actor/total_nframes")  # per-window drained counts
+    lsteps = series(rows, "learner/steps_per_sec")
+
+    out = {
+        "threshold": threshold,
+        "n_chips": n_chips,
+        "run_seconds": round(max(w for w, _, _ in evals + frames) - t0, 1),
+        "eval_curve": [[round(w - t0, 1), v, s] for w, v, s in evals],
+        "best_eval_reward": max(v for _, v, _ in evals) if evals else None,
+    }
+
+    hit = next(((w, v, s) for w, v, s in evals if v >= threshold), None)
+    if hit:
+        out["wall_clock_to_threshold_sec"] = round(hit[0] - t0, 1)
+        out["learner_steps_to_threshold"] = int(hit[2])
+    else:
+        out["wall_clock_to_threshold_sec"] = None
+
+    if len(frames) > 1:
+        span = frames[-1][0] - frames[0][0]
+        agent_steps = sum(v for _, v, _ in frames[1:])
+        out["env_frames_per_sec_per_chip"] = round(
+            agent_steps / span / n_chips, 1)
+        out["emulator_frames_per_sec_per_chip"] = round(
+            4 * agent_steps / span / n_chips, 1)
+        out["total_agent_steps"] = int(sum(v for _, v, _ in frames))
+    if lsteps:
+        vals = sorted(v for _, v, _ in lsteps if v > 0)
+        if vals:
+            out["learner_updates_per_sec_median"] = round(
+                vals[len(vals) // 2], 2)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log_dir")
+    ap.add_argument("--threshold", type=float, default=18.0)
+    ap.add_argument("--n-chips", type=int, default=1)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--meta", action="append", default=[], metavar="K=V")
+    args = ap.parse_args()
+
+    rep = report(args.log_dir, args.threshold, args.n_chips)
+    for kv in args.meta:
+        k, _, v = kv.partition("=")
+        rep[k] = v
+    text = json.dumps(rep, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    sys.stdout.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()
