@@ -190,7 +190,7 @@ class AgentParams:
     # dispatched XLA program (lax.scan over sample+train): program-launch
     # latency, not chip compute, bounds the hot loop when dispatch is
     # high-latency (tunnelled dev chips; congested hosts).  0 = auto
-    # (8 on TPU, 1 elsewhere).  Cadences (publish/checkpoint/stats) are
+    # (32 on TPU, 1 elsewhere).  Cadences (publish/checkpoint/stats) are
     # quantized to the dispatch size, and the ``steps`` budget itself may
     # overshoot by up to K-1 updates (the final dispatch is whole).
     steps_per_dispatch: int = 0
