@@ -1,0 +1,112 @@
+"""Run-budget and shutdown paths added for the time-boxed bench: the
+max_seconds wall-clock budget, the no-evaluator switch, and the
+stop-aware feeder that keeps teardown from deadlocking."""
+
+import multiprocessing as mp
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import build_options
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(240)
+def test_max_seconds_ends_run_like_steps_budget(tmp_path):
+    """A steps budget far beyond reach + a few-second wall budget: the
+    topology must return on the clock, not hang."""
+    from pytorch_distributed_tpu import runtime
+
+    opt = build_options(
+        1, root_dir=str(tmp_path), num_actors=1, steps=10 ** 9,
+        max_seconds=8.0, memory_size=1024, batch_size=16, learn_start=16,
+        visualize=False, evaluator_freq=10 ** 6)
+    t0 = time.monotonic()
+    topo = runtime.train(opt, backend="thread")
+    assert time.monotonic() - t0 < 120.0  # compile + 8s budget + join
+    assert topo.clock.stop.is_set()
+    assert topo.clock.learner_step.value < 10 ** 9
+
+
+def test_evaluator_nepisodes_zero_skips_evaluator_worker(tmp_path):
+    from pytorch_distributed_tpu.runtime import Topology
+
+    opt = build_options(1, root_dir=str(tmp_path), num_actors=2,
+                        evaluator_nepisodes=0, visualize=False)
+    topo = Topology(opt)
+    roles = [role for role, _, _ in topo._worker_specs()]
+    assert "evaluator" not in roles
+    assert roles.count("actor") == 2
+    # the logger's end-of-run drain gates on this handshake
+    assert topo.evaluator_stats.done.value == 1
+
+    opt2 = build_options(1, root_dir=str(tmp_path), num_actors=2,
+                         visualize=False)
+    topo2 = Topology(opt2)
+    assert "evaluator" in [r for r, _, _ in topo2._worker_specs()]
+
+
+class TestStopAwareFeeder:
+    def _transition(self):
+        from pytorch_distributed_tpu.utils.experience import Transition
+
+        z = np.zeros(2, np.float32)
+        return Transition(state0=z, action=np.int32(0),
+                          reward=np.float32(0.0), gamma_n=np.float32(0.9),
+                          state1=z, terminal1=np.float32(0.0))
+
+    def test_flush_aborts_on_stop_instead_of_blocking(self):
+        from pytorch_distributed_tpu.memory.feeder import QueueFeeder
+
+        q = mp.get_context("spawn").Queue(1)
+        f = QueueFeeder(q, chunk=1)
+        stop = mp.get_context("spawn").Event()
+        f.set_stop(stop)
+        f.feed(self._transition())  # fills the 1-slot queue
+        time.sleep(0.2)  # let the mp feeder thread push it into the pipe
+
+        # queue full, nobody draining: a flush must wait only until stop
+        f._buf = [(self._transition(), None)]
+        done = threading.Event()
+
+        def blocked_flush():
+            f.flush()
+            done.set()
+
+        t = threading.Thread(target=blocked_flush, daemon=True)
+        t.start()
+        assert not done.wait(0.6), "flush returned while queue still full"
+        stop.set()
+        assert done.wait(5.0), "flush did not abort on stop"
+        assert f._buf == []  # dropped, not retained
+        f.close()
+
+    def test_plain_put_for_sinks_without_timeout(self):
+        """Duck-typed sinks (the DCN _ChunkSink) have put(items) only —
+        the stop-aware branch must not pass timeout= to them."""
+        from pytorch_distributed_tpu.memory.feeder import QueueFeeder
+
+        class Sink:
+            def __init__(self):
+                self.items = []
+
+            def put(self, items):  # no timeout kwarg
+                self.items.append(items)
+
+        sink = Sink()
+        f = QueueFeeder(sink, chunk=1)
+        f.set_stop(mp.get_context("spawn").Event())
+        f.feed(self._transition())
+        assert len(sink.items) == 1
+
+    def test_clone_carries_stop(self):
+        from pytorch_distributed_tpu.memory.feeder import QueueFeeder
+
+        f = QueueFeeder(queue.Queue(4), chunk=2)
+        stop = threading.Event()
+        f.set_stop(stop)
+        c = f.clone()
+        assert c._stop is stop and c._timeout_put == f._timeout_put
