@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Plot scalar series from a run's JSONL metrics stream.
+
+The headless quick-look replacement for TensorBoard: reads
+``<log_dir>/scalars.jsonl`` (utils/metrics.py format) and renders the
+requested tags, one panel per tag, sharing the x-axis.
+
+Usage:
+    python tools/plot_run.py <log_dir> [--tags evaluator/avg_reward ...] \
+        [--x wall|step] [--out run.png]
+
+Defaults: the three headline tags, x = wall-clock minutes,
+out = <log_dir>/run.png.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from pytorch_distributed_tpu.utils.metrics import read_scalars  # noqa: E402
+
+DEFAULT_TAGS = ("evaluator/avg_reward", "learner/critic_loss",
+                "actor/total_nframes")
+
+# thin marks, recessive grid, neutral ink; blue = categorical slot 1
+INK, MUTED, GRID, BLUE = "#1a1a1a", "#6b6b6b", "#e5e5e5", "#2a78d6"
+
+
+def load_series(log_dir: str, tags):
+    rows = read_scalars(log_dir)
+    series = {t: [] for t in tags}
+    t0 = min((r["wall"] for r in rows), default=None)
+    for r in rows:
+        if r["tag"] in series:
+            series[r["tag"]].append((r["wall"], r.get("step", 0),
+                                     r["value"]))
+    return series, t0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log_dir")
+    ap.add_argument("--tags", nargs="+", default=list(DEFAULT_TAGS))
+    ap.add_argument("--x", choices=("wall", "step"), default="wall")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    series, t0 = load_series(args.log_dir, args.tags)
+    tags = [t for t in args.tags if series[t]]
+    if not tags or t0 is None:
+        raise SystemExit(f"none of {args.tags} found in "
+                         f"{args.log_dir}/scalars.jsonl")
+
+    fig, axes = plt.subplots(len(tags), 1, figsize=(7.2, 2.4 * len(tags)),
+                             dpi=150, sharex=True, squeeze=False)
+    fig.patch.set_facecolor("white")
+    for ax, tag in zip(axes[:, 0], tags):
+        pts = series[tag]
+        xs = [(w - t0) / 60.0 if args.x == "wall" else s
+              for w, s, _ in pts]
+        ax.plot(xs, [v for _, _, v in pts], color=BLUE, lw=2.0,
+                solid_capstyle="round", zorder=3)
+        ax.set_facecolor("white")
+        ax.set_title(tag, fontsize=9.5, color=INK, loc="left")
+        ax.grid(True, color=GRID, lw=0.7, zorder=0)
+        for s in ("top", "right"):
+            ax.spines[s].set_visible(False)
+        for s in ("left", "bottom"):
+            ax.spines[s].set_color(GRID)
+        ax.tick_params(colors=MUTED, labelsize=8)
+    axes[-1, 0].set_xlabel(
+        "wall-clock (minutes)" if args.x == "wall" else "learner step",
+        fontsize=9, color=MUTED)
+    fig.tight_layout()
+    out = args.out or os.path.join(args.log_dir, "run.png")
+    fig.savefig(out, bbox_inches="tight")
+    print(out)
+
+
+if __name__ == "__main__":
+    main()
