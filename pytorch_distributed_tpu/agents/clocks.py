@@ -84,7 +84,7 @@ class LearnerStats(_Accumulator):
     reference ddpg_logger.py:51)."""
 
     FIELDS = ("counter", "critic_loss", "actor_loss", "q_mean", "grad_norm",
-              "steps_per_sec")
+              "steps_per_sec", "moe_aux")
 
 
 class EvaluatorStats:

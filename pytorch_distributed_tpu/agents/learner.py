@@ -66,7 +66,8 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     # the sp axis) are built against it
     mesh = None
     if len(jax.devices()) > 1:
-        mesh = make_mesh(pp.dp_size, pp.mp_size, pp.sp_size)
+        mesh = make_mesh(pp.dp_size, pp.mp_size, pp.sp_size, pp.ep_size,
+                         pp.pp_size)
     model = build_model(opt, spec)
     params = init_params(opt, spec, model, seed=opt.seed)
     if opt.model_file:
@@ -88,6 +89,32 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         )
 
         state_shardings = dtqn_state_shardings(state, mesh)
+    if mesh is not None and pp.ep_size > 1:
+        # expert parallelism: MoE expert kernels split over ep
+        # (parallel/expert_parallel.py); mutually exclusive with the mp
+        # split — the DTQN families are either dense (mp) or MoE (ep)
+        assert opt.model_type == "dtqn-moe", (
+            f"ep_size>1 is only supported for dtqn-moe "
+            f"(got {opt.model_type})")
+        assert pp.mp_size == 1, "ep and mp splits don't compose"
+        from pytorch_distributed_tpu.parallel.expert_parallel import (
+            moe_state_shardings,
+        )
+
+        state_shardings = moe_state_shardings(state, mesh)
+    if mesh is not None and pp.pp_size > 1:
+        # pipeline parallelism: stacked block layer axis over pp
+        # (parallel/pipeline.py); exclusive with the other model splits
+        assert opt.model_type == "dtqn-pipe", (
+            f"pp_size>1 is only supported for dtqn-pipe "
+            f"(got {opt.model_type})")
+        assert pp.mp_size == 1 and pp.ep_size == 1, (
+            "pp does not compose with mp/ep splits")
+        from pytorch_distributed_tpu.parallel.pipeline import (
+            pipeline_state_shardings,
+        )
+
+        state_shardings = pipeline_state_shardings(state, mesh)
     learner = ShardedLearner(step_fn, mesh, donate=pp.donate,
                              state_shardings=state_shardings)
     state = learner.place(state)
@@ -337,6 +364,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                 actor_loss=vals.get("learner/actor_loss", 0.0),
                 q_mean=vals.get("learner/q_mean", 0.0),
                 grad_norm=vals.get("learner/grad_norm", 0.0),
+                moe_aux=vals.get("learner/moe_aux", 0.0),
                 steps_per_sec=(lstep - last_stats_lstep)
                 / max(now - t_cadence, 1e-9),
             )

@@ -75,6 +75,9 @@ def run_logger(opt: Options, clock: GlobalClock, actor_stats: ActorStats,
                         "learner/grad_norm": le["grad_norm"] / le["counter"],
                         "learner/steps_per_sec":
                             le["steps_per_sec"] / le["counter"],
+                        # nonzero only for MoE models (models/moe.py);
+                        # rides along like actor_loss does for non-DDPG
+                        "learner/moe_aux": le["moe_aux"] / le["counter"],
                     }, step=step)
                 writer.flush()
 

@@ -53,6 +53,8 @@ CONFIGS = [
     ["r2d2",      "pong-sim",  "pong",        "sequence",    "drqn-cnn"],# 14 R2D2 pixels
     ["r2d2",      "fake",      "chain",       "sequence",    "dtqn-mlp"],# 15 transformer Q (DTQN)
     ["ddpg",      "classic",   "reacher",     "shared",      "ddpg-mlp"],# 16 multi-dim continuous control
+    ["r2d2",      "fake",      "chain",       "sequence",    "dtqn-moe"],# 17 MoE transformer Q (expert parallel)
+    ["r2d2",      "fake",      "chain",       "sequence",    "dtqn-pipe"],# 18 staged transformer Q (pipeline parallel)
 ]
 
 
@@ -133,6 +135,13 @@ class ModelParams:
     tf_dim: int = 128
     tf_heads: int = 4
     tf_depth: int = 2
+    # MoE (dtqn-moe) routing: expert count, choices per token, per-row
+    # slot headroom, and the Switch load-balancing loss weight
+    # (models/moe.py)
+    moe_experts: int = 8
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     # Apply orthogonal init for the CNN.  The reference *defines* orthogonal
     # init but never applies it (dqn_cnn_model.py:33 commented out) — here it
     # is applied and this flag documents the deliberate divergence.
@@ -273,6 +282,13 @@ class ParallelParams:
     # (head/time all-to-all, needs heads % sp == 0;
     # ops/ulysses_attention.py docstring has the trade-off)
     sp_attention: str = "ring"
+    # expert parallel: MoE expert kernels shard over the ep axis
+    # (dtqn-moe only; parallel/expert_parallel.py)
+    ep_size: int = 1
+    # pipeline parallel: stacked DTQN blocks stage over the pp axis with
+    # a GPipe microbatch schedule (dtqn-pipe only; parallel/pipeline.py)
+    pp_size: int = 1
+    pp_microbatches: int = 4
     # Donate learner buffers (params/opt_state) to the jit step.
     donate: bool = True
     # Multi-host: call jax.distributed.initialize (DCN) before device init.

@@ -58,7 +58,7 @@ MemoriesDict: Dict[str, Optional[Callable]] = {
 
 # model ctors bound in build_model below (they need probed shapes)
 ModelTypes = ("dqn-cnn", "dqn-mlp", "ddpg-mlp", "drqn-mlp", "drqn-cnn",
-              "dtqn-mlp")
+              "dtqn-mlp", "dtqn-moe", "dtqn-pipe")
 
 
 def _worker_dicts():
@@ -260,10 +260,10 @@ def build_model(opt: Options, spec: EnvSpec):
                             hidden_dim=mp_.hidden_dim,
                             lstm_dim=mp_.lstm_dim,
                             norm_val=spec.norm_val)
-    if opt.model_type == "dtqn-mlp":
+    if opt.model_type in ("dtqn-mlp", "dtqn-moe", "dtqn-pipe"):
         from pytorch_distributed_tpu.models.dtqn import DtqnMlpModel
 
-        return DtqnMlpModel(
+        kw = dict(
             action_space=spec.num_actions,
             state_shape=spec.state_shape,
             # the acting window and the learner's T+1-long segments share
@@ -274,6 +274,21 @@ def build_model(opt: Options, spec: EnvSpec):
             heads=mp_.tf_heads,
             depth=mp_.tf_depth,
             norm_val=spec.norm_val)
+        if opt.model_type == "dtqn-moe":
+            from pytorch_distributed_tpu.models.moe import DtqnMoeModel
+
+            return DtqnMoeModel(
+                num_experts=mp_.moe_experts,
+                top_k=mp_.moe_top_k,
+                capacity_factor=mp_.moe_capacity_factor,
+                **kw)
+        if opt.model_type == "dtqn-pipe":
+            from pytorch_distributed_tpu.models.dtqn_pipeline import (
+                DtqnPipelineModel,
+            )
+
+            return DtqnPipelineModel(**kw)
+        return DtqnMlpModel(**kw)
     if opt.model_type == "drqn-cnn":
         from pytorch_distributed_tpu.models.drqn import DrqnCnnModel
 
@@ -295,7 +310,13 @@ def example_obs(opt: Options, spec: EnvSpec, batch: int = 1):
 def init_params(opt: Options, spec: EnvSpec, model, seed: int):
     import jax
 
-    return model.init(jax.random.PRNGKey(seed), example_obs(opt, spec))
+    variables = model.init(jax.random.PRNGKey(seed), example_obs(opt, spec))
+    # keep ONLY the param collection: flax init also captures any sown
+    # collections (the MoE aux losses, models/moe.py AUX_COLLECTION), and
+    # letting those scalars ride inside TrainState.params would make them
+    # trainable free parameters seeding every later sow reduce
+    return {"params": variables["params"]} if "params" in variables \
+        else variables
 
 
 def ddpg_applies(model) -> Tuple[Callable, Callable]:
@@ -359,6 +380,24 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
             kw["burn_in"] = 0
             train_model = model
             sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+            pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+            if pp > 1:
+                # pipeline parallelism: stage the stacked block family
+                # over pp with the GPipe microbatch schedule
+                # (parallel/pipeline.py); exclusive with sp — they split
+                # the same transformer along different dims
+                assert opt.model_type == "dtqn-pipe", (
+                    f"pp_size>1 needs model_type dtqn-pipe "
+                    f"(got {opt.model_type})")
+                assert sp == 1, "pp and sp splits don't compose"
+                from pytorch_distributed_tpu.parallel.pipeline import (
+                    pipelined_window_apply,
+                )
+
+                window_apply = pipelined_window_apply(
+                    model, mesh, opt.parallel_params.pp_microbatches)
+                step = build_dtqn_train_step(window_apply, tx, **kw)
+                return state, step
             if sp > 1:
                 # long windows: shard the time axis over sp; attention
                 # rides the ring or the Ulysses all-to-all (same params,
@@ -381,8 +420,18 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
                     assert strategy == "ring", (
                         f"unknown sp_attention: {strategy}")
                     train_model = with_ring_attention(model, mesh)
-            window_apply = lambda p, obs: train_model.apply(
-                p, obs, method=train_model.window_q)
+            if opt.model_type == "dtqn-moe":
+                # MoE: the apply surfaces the sown load-balancing losses
+                # as a (q, aux) tuple; the step adds aux_weight * aux
+                from pytorch_distributed_tpu.models.moe import (
+                    window_q_with_aux,
+                )
+
+                window_apply = window_q_with_aux(train_model)
+                kw["aux_weight"] = opt.model_params.moe_aux_weight
+            else:
+                window_apply = lambda p, obs: train_model.apply(
+                    p, obs, method=train_model.window_q)
             step = build_dtqn_train_step(window_apply, tx, **kw)
         else:
             step = build_drqn_train_step(model.apply, tx, **kw)

@@ -35,6 +35,31 @@ from pytorch_distributed_tpu.ops.ring_attention import full_attention
 Carry = Tuple[jnp.ndarray, jnp.ndarray]  # (window (B,W,*S) f32, filled (B,))
 
 
+def attention_half(block: nn.Module, x: jnp.ndarray,
+                   pad_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """The attention residual of a pre-LN block — shared by the dense
+    `_Block` here and the MoE block (models/moe.py) so the two families
+    cannot drift.  ``block`` provides dim/heads/attn and the module scope
+    (submodules register under the caller, keeping the historical
+    Dense_0/Dense_1 auto-names that parallel/tensor_parallel.py's
+    path rules rely on).  Must be called first inside the block's compact
+    ``__call__``."""
+    B, T, _ = x.shape
+    hdim = block.dim // block.heads
+    y = nn.LayerNorm()(x)
+    qkv = nn.Dense(3 * block.dim)(y).reshape(B, T, 3, block.heads, hdim)
+    q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    if pad_mask is not None:
+        # acting path: unfilled window slots masked out; the injected
+        # attn hook (ring) has no padding concept, but acting windows
+        # always fit one device, so dense attention is the right call
+        o = full_attention(q, k, v, causal=True, key_pad_mask=pad_mask)
+    else:
+        o = (block.attn or full_attention)(q, k, v, causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, block.dim)
+    return x + nn.Dense(block.dim)(o)
+
+
 class _Block(nn.Module):
     """Pre-LN transformer block with causal (+padding-masked) attention."""
 
@@ -45,20 +70,7 @@ class _Block(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray,
                  pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-        B, T, _ = x.shape
-        hdim = self.dim // self.heads
-        y = nn.LayerNorm()(x)
-        qkv = nn.Dense(3 * self.dim)(y).reshape(B, T, 3, self.heads, hdim)
-        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
-        if pad_mask is not None:
-            # acting path: unfilled window slots masked out; the injected
-            # attn hook (ring) has no padding concept, but acting windows
-            # always fit one device, so dense attention is the right call
-            o = full_attention(q, k, v, causal=True, key_pad_mask=pad_mask)
-        else:
-            o = (self.attn or full_attention)(q, k, v, causal=True)
-        o = o.transpose(0, 2, 1, 3).reshape(B, T, self.dim)
-        x = x + nn.Dense(self.dim)(o)
+        x = attention_half(self, x, pad_mask)
         y = nn.LayerNorm()(x)
         y = nn.Dense(4 * self.dim)(y)
         x = x + nn.Dense(self.dim)(nn.gelu(y))

@@ -1,0 +1,167 @@
+"""Pipeline-ready DTQN: the transformer stack as STACKED raw block params.
+
+No reference equivalent (the reference is single-GPU; SURVEY.md §2 lists
+pipeline parallelism as NOT present there).  This is the model family
+behind the mesh ``pp`` axis (parallel/pipeline.py): every transformer
+block's parameters live in one pytree of arrays with a leading layer
+axis ``(depth, ...)``, so
+
+- single-device execution is a ``lax.scan`` over the layer axis (the
+  "scan over layers" pattern XLA compiles to one block program), and
+- pipeline execution shards that SAME leading axis over ``pp`` — each
+  stage holds ``depth / pp`` contiguous blocks — with microbatches
+  flowing stage-to-stage via ``ppermute`` (GPipe schedule, expressed as
+  a shard_map; parallel/pipeline.py).
+
+The block math (pre-LN causal attention + GELU FFN) is written ONCE as
+the pure function ``block_forward`` on raw params and is used by both
+paths, so the pipeline equivalence tests pin the scheduling machinery,
+not a re-implementation of the math.  Embedding, final LN and the
+zero-init Q head stay ordinary Flax submodules outside the pipelined
+region (they are a few percent of the FLOPs; replicating their compute
+is cheaper than two extra pipeline stages).
+
+The acting/learner contract (window carry, leading-aligned positions,
+``window_q``) is inherited from models/dtqn.py ``DtqnMlpModel``
+unchanged — only ``_encode`` is overridden.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.models.dtqn import DtqnMlpModel
+from pytorch_distributed_tpu.ops.ring_attention import full_attention
+
+BlockParams = Dict[str, jnp.ndarray]
+
+
+def block_forward(p: BlockParams, x: jnp.ndarray, *, heads: int,
+                  key_pad_mask: Optional[jnp.ndarray] = None
+                  ) -> jnp.ndarray:
+    """One pre-LN transformer block on raw params — the single source of
+    the block math for both the sequential scan and the pipeline stages.
+
+    ``p`` holds one layer's slice: ln1_{s,b}, qkv_{k,b}, proj_{k,b},
+    ln2_{s,b}, ffn1_{k,b}, ffn2_{k,b}.
+    """
+    B, T, D = x.shape
+    hdim = D // heads
+
+    def ln(h, scale, bias):
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.var(h, axis=-1, keepdims=True)
+        return (h - mu) * jax.lax.rsqrt(var + 1e-6) * scale + bias
+
+    y = ln(x, p["ln1_s"], p["ln1_b"])
+    qkv = (y @ p["qkv_k"] + p["qkv_b"]).reshape(B, T, 3, heads, hdim)
+    q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    o = full_attention(q, k, v, causal=True, key_pad_mask=key_pad_mask)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, D)
+    x = x + o @ p["proj_k"] + p["proj_b"]
+    y = ln(x, p["ln2_s"], p["ln2_b"])
+    y = nn.gelu(y @ p["ffn1_k"] + p["ffn1_b"])
+    return x + y @ p["ffn2_k"] + p["ffn2_b"]
+
+
+def scan_blocks(stacked: BlockParams, x: jnp.ndarray, *, heads: int,
+                key_pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Sequential execution: lax.scan over the leading layer axis."""
+
+    def body(h, layer):
+        return block_forward(layer, h, heads=heads,
+                             key_pad_mask=key_pad_mask), None
+
+    out, _ = jax.lax.scan(body, x, stacked)
+    return out
+
+
+class _StackedBlockParams(nn.Module):
+    """Parameter-only submodule holding the stacked block pytree — its
+    leaves live under ``params/blocks/...`` so the pipeline sharding rule
+    (parallel/pipeline.py) can key on the path."""
+
+    dim: int
+    depth: int
+
+    @nn.compact
+    def __call__(self) -> BlockParams:
+        D, H, depth = self.dim, 4 * self.dim, self.depth
+        lecun = nn.initializers.lecun_normal()
+
+        # a vmapped lecun init keeps per-layer fan-in statistics despite
+        # the leading layer axis
+        def stacked_kernel(key, shape):
+            return jax.vmap(lambda k: lecun(k, shape[1:]))(
+                jax.random.split(key, shape[0]))
+
+        mk = self.param
+        return {
+            "ln1_s": mk("ln1_s", nn.initializers.ones, (depth, D)),
+            "ln1_b": mk("ln1_b", nn.initializers.zeros, (depth, D)),
+            "qkv_k": mk("qkv_k", stacked_kernel, (depth, D, 3 * D)),
+            "qkv_b": mk("qkv_b", nn.initializers.zeros, (depth, 3 * D)),
+            "proj_k": mk("proj_k", stacked_kernel, (depth, D, D)),
+            "proj_b": mk("proj_b", nn.initializers.zeros, (depth, D)),
+            "ln2_s": mk("ln2_s", nn.initializers.ones, (depth, D)),
+            "ln2_b": mk("ln2_b", nn.initializers.zeros, (depth, D)),
+            "ffn1_k": mk("ffn1_k", stacked_kernel, (depth, D, H)),
+            "ffn1_b": mk("ffn1_b", nn.initializers.zeros, (depth, H)),
+            "ffn2_k": mk("ffn2_k", stacked_kernel, (depth, H, D)),
+            "ffn2_b": mk("ffn2_b", nn.initializers.zeros, (depth, D)),
+        }
+
+
+class DtqnPipelineModel(DtqnMlpModel):
+    """DTQN whose block stack is one stacked-param pytree (leading
+    ``depth`` axis) under the param subtree ``blocks`` — shardable over
+    the mesh ``pp`` axis by parallel/pipeline.py.  Same acting/learner
+    contract as DtqnMlpModel.  The learner swaps ``window_q`` for the
+    pipelined apply when ``pp_size > 1`` (factory.py);
+    sequence-parallel attention injection (``attn``) is not supported on
+    this family — pp and sp address the same too-big-for-one-chip
+    problem along different dims.
+
+    Setup-based (no compact method) so ``embed`` and ``head`` are
+    independently callable via ``model.apply(..., method=...)`` — the
+    pipeline op composes embed -> pipelined blocks -> head from outside
+    the module (parallel/pipeline.py::pipelined_window_apply).
+    """
+
+    def setup(self) -> None:
+        assert self.attn is None, (
+            "DtqnPipelineModel does not take injected sp attention; use "
+            "DtqnMlpModel for sequence parallelism")
+        # setup-style: attribute names become the param-tree keys
+        # (embed_in, blocks, head_ln, head_q)
+        self.embed_in = nn.Dense(self.dim)
+        self.pos_embed = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.window, self.dim))
+        self.blocks = _StackedBlockParams(self.dim, self.depth)
+        self.head_ln = nn.LayerNorm()
+        # zero-init head: same bootstrapping rationale as models/dtqn.py
+        self.head_q = nn.Dense(self.action_space,
+                               kernel_init=nn.initializers.zeros)
+
+    def _encode(self, win: jnp.ndarray,
+                pad_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+        x = self.embed(win)
+        x = scan_blocks(self.blocks(), x, heads=self.heads,
+                        key_pad_mask=pad_mask)
+        return self.head(x)
+
+    # ---- pieces the pipeline op re-composes ---------------------------
+
+    def embed(self, win: jnp.ndarray) -> jnp.ndarray:
+        B, T = win.shape[0], win.shape[1]
+        x = win.astype(jnp.float32) / self.norm_val
+        x = x.reshape(B, T, -1)
+        return self.embed_in(x) + self.pos_embed[:T]
+
+    def head(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self.head_q(self.head_ln(x))

@@ -1,0 +1,222 @@
+"""Mixture-of-Experts DTQN: expert-parallel FFN over the mesh ``ep`` axis.
+
+No reference equivalent (the reference is a single-GPU dense-model repo;
+SURVEY.md §2 lists expert parallelism as NOT present there) — this is the
+TPU-native capability that makes the mesh's ``ep`` axis real: the DTQN
+transformer's FFN becomes a top-k-routed mixture of experts whose expert
+kernels shard over ``ep`` (parallel/expert_parallel.py).
+
+Design, the GShard/Switch dataflow expressed the XLA-SPMD way — einsum
+dispatch/combine with static capacity, sharding annotations only, no
+manual collectives:
+
+- router: one Dense(E) per MoE block; softmax over experts; top-k choices
+  per token, gates renormalised over the chosen k;
+- capacity: each expert accepts at most C = ceil(capacity_factor * k *
+  T / E) tokens **per batch row** (grouping by row keeps the slot cumsum
+  local to the dp shard — no cross-device prefix sums on the hot path);
+  overflow tokens are dropped for that expert (their residual branch
+  simply contributes nothing, the standard Switch behaviour);
+- dispatch/combine: one-hot (B, T, E, C) tensors turn routing into two
+  einsums around the expert-batched FFN matmuls (E-leading kernels).
+  Under jit with the batch dp-sharded and the expert kernels ep-sharded,
+  XLA runs each device's expert slice locally and closes the combine
+  contraction over E with one psum over ep — expert parallelism as a
+  compiler-inserted collective, the same way tensor_parallel.py gets its
+  Megatron psum;
+- aux loss: the Switch load-balancing term E * sum_e f_e * P_e (f_e =
+  fraction of tokens whose top-1 choice is e, P_e = mean router prob),
+  sown into the ``moe_losses`` collection; the DTQN train step adds it
+  with weight ``moe_aux_weight`` (ops/sequence_losses.py aux_weight).
+
+The model class mirrors models/dtqn.py `DtqnMlpModel` exactly on the
+acting/learner contract (window carry, leading-aligned positions,
+window_q) so the whole r2d2 pipeline is reused unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.models.dtqn import DtqnMlpModel, attention_half
+
+AUX_COLLECTION = "moe_losses"
+
+
+def _top_k_dispatch(probs: jnp.ndarray, top_k: int, capacity: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Routing tensors from per-token expert probabilities.
+
+    probs: (B, T, E) softmax router output.  Returns
+
+    - dispatch (B, T, E, C) in {0,1}: token t of row b occupies slot c of
+      expert e;
+    - combine  (B, T, E, C) float: dispatch scaled by the token's
+      renormalised gate for that expert;
+    - f_top1   (B, T, E) in {0,1}: rank-0 assignment mask (for the aux
+      loss), before any capacity drop.
+
+    Slots are assigned in (rank, time) priority order: all rank-0 choices
+    claim capacity before any rank-1 choice, earlier tokens before later
+    ones — the deterministic Switch/GShard policy.
+    """
+    B, T, E = probs.shape
+    top_p, top_i = jax.lax.top_k(probs, top_k)            # (B, T, k)
+    # renormalise gates over the chosen k
+    top_p = top_p / jnp.maximum(jnp.sum(top_p, -1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((B, T, E, capacity), probs.dtype)
+    combine = jnp.zeros((B, T, E, capacity), probs.dtype)
+    count = jnp.zeros((B, E), probs.dtype)  # slots already claimed
+    for r in range(top_k):  # static unroll; k is 1 or 2
+        mask_r = jax.nn.one_hot(top_i[..., r], E, dtype=probs.dtype)
+        if r == 0:
+            f_top1 = mask_r
+        # slot index for each token at this rank: previously claimed slots
+        # plus this rank's exclusive running count along time
+        pos = count[:, None, :] + jnp.cumsum(mask_r, axis=1) - mask_r
+        keep = mask_r * (pos < capacity)
+        slot_hot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                  dtype=probs.dtype)      # (B, T, E, C)
+        dispatch = dispatch + keep[..., None] * slot_hot
+        combine = combine + (keep * top_p[..., r:r + 1])[..., None] \
+            * slot_hot
+        count = count + jnp.sum(mask_r, axis=1)
+    return dispatch, combine, f_top1
+
+
+class MoeFfn(nn.Module):
+    """Top-k routed expert FFN (dim -> hidden -> dim), expert-batched
+    kernels with a leading E dim so ``ep`` sharding is one PartitionSpec.
+    Returns (y, aux) — aux is the Switch load-balancing loss, also sown
+    into ``moe_losses``."""
+
+    dim: int
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    hidden_mult: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        B, T, D = x.shape
+        E, k = self.num_experts, min(self.top_k, self.num_experts)
+        H = self.hidden_mult * self.dim
+        capacity = max(int(-(-self.capacity_factor * k * T // E)), 1)
+
+        logits = nn.Dense(E, name="router")(x)            # (B, T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine, f_top1 = _top_k_dispatch(probs, k, capacity)
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (E, D, H))
+        b1 = self.param("b1", nn.initializers.zeros, (E, H))
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (E, H, D))
+        b2 = self.param("b2", nn.initializers.zeros, (E, D))
+
+        # (B, E, C, D): each expert's token slab for this batch shard
+        xin = jnp.einsum("btec,btd->becd", dispatch, x)
+        h = nn.gelu(jnp.einsum("becd,edh->bech", xin, w1)
+                    + b1[None, :, None, :])
+        out = jnp.einsum("bech,ehd->becd", h, w2) + b2[None, :, None, :]
+        # combine contracts over (e, c): with experts ep-sharded XLA
+        # closes this with the psum over ep
+        y = jnp.einsum("becd,btec->btd", out, combine)
+
+        # Switch aux: E * sum_e (token fraction routed to e) * (mean prob)
+        f = jnp.mean(f_top1, axis=(0, 1))                 # (E,)
+        p = jnp.mean(probs, axis=(0, 1))                  # (E,)
+        aux = jnp.asarray(E, x.dtype) * jnp.sum(f * p)
+        self.sow(AUX_COLLECTION, "aux", aux)
+        return y, aux
+
+
+class _MoeBlock(nn.Module):
+    """Pre-LN transformer block: causal attention + MoE FFN.  The
+    attention half IS models/dtqn.py's (shared ``attention_half`` — same
+    padding semantics, same injected-attn hook for sequence
+    parallelism)."""
+
+    dim: int
+    heads: int
+    num_experts: int
+    top_k: int
+    capacity_factor: float
+    attn: Optional[object] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 pad_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        x = attention_half(self, x, pad_mask)
+        y = nn.LayerNorm()(x)
+        ffn_out, _ = MoeFfn(self.dim, self.num_experts, self.top_k,
+                            self.capacity_factor, name="moe")(y)
+        return x + ffn_out
+
+
+class DtqnMoeModel(DtqnMlpModel):
+    """DTQN with every block's FFN replaced by a routed expert mixture.
+
+    Same acting/learner contract as DtqnMlpModel (it inherits the window
+    carry, window_q and act paths); only ``_encode`` changes.  The aux
+    load-balancing losses are sown — the learner applies with
+    ``mutable=[AUX_COLLECTION]`` and feeds their MEAN over blocks to the
+    train step's ``aux_weight`` term (factory.py wires this;
+    ``window_q_with_aux`` below).
+    """
+
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def _encode(self, win: jnp.ndarray,
+                pad_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+        B, T = win.shape[0], win.shape[1]
+        x = win.astype(jnp.float32) / self.norm_val
+        x = x.reshape(B, T, -1)
+        x = nn.Dense(self.dim)(x)
+        x = x + self.param("pos_embed", nn.initializers.normal(0.02),
+                           (self.window, self.dim))[:T]
+        for _ in range(self.depth):
+            x = _MoeBlock(self.dim, self.heads, self.num_experts,
+                          self.top_k, self.capacity_factor,
+                          self.attn)(x, pad_mask)
+        x = nn.LayerNorm()(x)
+        # zero-init head for the same bootstrapping reason as the dense
+        # DTQN (models/dtqn.py::_encode)
+        return nn.Dense(self.action_space,
+                        kernel_init=nn.initializers.zeros)(x)
+
+
+def window_q_with_aux(model: DtqnMoeModel):
+    """(params, obs_seq) -> (q, aux_mean): the learner-side apply that
+    surfaces the sown load-balancing losses, averaged over the MoE blocks
+    (depth-invariant, so ``moe_aux_weight`` needs no retuning when
+    ``tf_depth`` changes).  Matches the tuple-returning window_apply
+    contract of ops/sequence_losses.build_dtqn_train_step.
+
+    Only the ``params`` collection is passed through: a variables dict
+    that (incorrectly) still carries init-time sown ``moe_losses`` leaves
+    must not seed the sow reduce — stored aux values would become free
+    parameters with a constant positive gradient under aux_weight, and
+    Adam would drive them unboundedly negative (factory.init_params
+    strips them at the source; this guards direct callers).
+    """
+
+    def apply(params, obs_seq):
+        variables = {"params": params["params"]} if "params" in params \
+            else params
+        q, aux_vars = model.apply(variables, obs_seq,
+                                  method=model.window_q,
+                                  mutable=[AUX_COLLECTION])
+        sown = jax.tree_util.tree_leaves(aux_vars)
+        aux = sum(sown) / max(len(sown), 1)
+        return q, aux
+
+    return apply

@@ -125,7 +125,7 @@ def _bootstrap_values(q_tm, q_target_tm, enable_double, h_inv):
 
 
 def _apply_update(state, grads, loss, seq_pr, q_mean, tx,
-                  target_model_update):
+                  target_model_update, extra_metrics=None):
     updates, opt_state = tx.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     new_step = state.step + 1
@@ -136,6 +136,8 @@ def _apply_update(state, grads, loss, seq_pr, q_mean, tx,
         "learner/q_mean": q_mean,
         "learner/grad_norm": global_norm(grads),
     }
+    if extra_metrics:
+        metrics.update(extra_metrics)
     return (TrainState(params, target_params, opt_state, new_step),
             metrics, seq_pr)
 
@@ -216,6 +218,7 @@ def build_dtqn_train_step(
     rescale_values: bool = True,
     priority_eta: float = 0.9,
     axis_name: str | None = None,
+    aux_weight: float = 0.0,
 ) -> Callable[[TrainState, SegmentBatch],
               Tuple[TrainState, Dict[str, jnp.ndarray], jnp.ndarray]]:
     """Transformer (DTQN) sequence update: same contract as
@@ -223,17 +226,27 @@ def build_dtqn_train_step(
     time scan — ``window_apply(params, obs_seq (B,T+1,*S)) -> (B,T+1,A)``
     (models/dtqn.py window_q).  There is no stored recurrent state: the
     burn-in prefix participates as attention context only (positions
-    before ``burn_in`` are excluded from the loss)."""
+    before ``burn_in`` are excluded from the loss).
+
+    MoE models (models/moe.py) pass a ``window_apply`` returning
+    ``(q, aux)`` instead — the auxiliary load-balancing loss joins the TD
+    loss with weight ``aux_weight`` and surfaces as
+    ``learner/moe_aux``."""
 
     h = value_rescale if rescale_values else (lambda x: x)
     h_inv = value_unrescale if rescale_values else (lambda x: x)
+
+    def split_apply(params, obs):
+        out = window_apply(params, obs)
+        # tuple-vs-array is static python structure, resolved at trace time
+        return out if isinstance(out, tuple) else (out, jnp.float32(0.0))
 
     def step(state: TrainState, batch: SegmentBatch):
         T = batch.action.shape[1]
         train_len = T - burn_in
         # (L+1, B, A) over the train window, burn-in kept as context
         to_tm = lambda q: jnp.moveaxis(q, 0, 1)[burn_in:]
-        q_target_tm = to_tm(window_apply(state.target_params, batch.obs))
+        q_target_tm = to_tm(split_apply(state.target_params, batch.obs)[0])
 
         a_tm = jnp.moveaxis(batch.action, 0, 1)[burn_in:]
         r_tm = jnp.moveaxis(batch.reward, 0, 1)[burn_in:]
@@ -241,7 +254,8 @@ def build_dtqn_train_step(
         m_tm = jnp.moveaxis(batch.mask, 0, 1)[burn_in:]
 
         def loss_fn(params):
-            q_tm = to_tm(window_apply(params, batch.obs))
+            q, aux = split_apply(params, batch.obs)
+            q_tm = to_tm(q)
             q_sel = jnp.take_along_axis(
                 q_tm[:train_len], a_tm[..., None].astype(jnp.int32),
                 axis=-1)[..., 0]
@@ -251,13 +265,15 @@ def build_dtqn_train_step(
                                             nstep=nstep, gamma=gamma))
             loss, seq_pr = _masked_loss_and_priority(
                 q_sel, target, m_tm, batch.weight, priority_eta)
-            return loss, (seq_pr, jnp.mean(jnp.max(q_tm, axis=-1)))
+            loss = loss + aux_weight * aux
+            return loss, (seq_pr, jnp.mean(jnp.max(q_tm, axis=-1)), aux)
 
-        (loss, (seq_pr, q_mean)), grads = jax.value_and_grad(
+        (loss, (seq_pr, q_mean, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
         if axis_name is not None:
             grads = jax.lax.pmean(grads, axis_name)
+        extra = {"learner/moe_aux": aux} if aux_weight else None
         return _apply_update(state, grads, loss, seq_pr, q_mean, tx,
-                             target_model_update)
+                             target_model_update, extra)
 
     return step

@@ -1,0 +1,56 @@
+"""Expert parallelism: MoE expert kernels sharded over the mesh ``ep`` axis.
+
+No reference equivalent (SURVEY.md §2 "parallelism strategies" lists
+expert parallelism as NOT present in the single-GPU reference) — this is
+the sharding rule that makes the mesh's ``ep`` axis real for the MoE DTQN
+(models/moe.py).
+
+Same design stance as parallel/tensor_parallel.py: sharding annotations
+only, no manual collectives.  Every MoeFfn parameter carries a leading
+expert dim (w1 (E,D,H), b1 (E,H), w2 (E,H,D), b2 (E,D)) and is split over
+``ep`` on that axis; router kernels, attention, embeddings and optimizer
+scalars replicate.  Under jit XLA's SPMD partitioner then runs each
+device's expert slice locally and closes the combine einsum's contraction
+over E with one psum over ep (models/moe.py docstring walks the
+dataflow).  The Adam moments mirror the param tree, so the same
+path-suffix rule shards them identically — optimizer memory for the
+experts also drops by 1/ep per chip.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.parallel.tensor_parallel import _path_strings
+
+_EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
+
+
+def _spec_for_path(path, leaf) -> P:
+    keys = _path_strings(path)
+    if "moe" in keys and keys[-1] in _EXPERT_LEAVES:
+        # leading expert dim over ep; everything else per-expert local
+        return P("ep", *([None] * (leaf.ndim - 1)))
+    return P()
+
+
+def moe_state_shardings(state: Any, mesh: Mesh) -> Any:
+    """A NamedSharding pytree for a DtqnMoeModel TrainState (params,
+    target params and Adam moments share the param paths, so one suffix
+    rule shards all three); pass to ``ShardedLearner(state_shardings=...)``.
+    """
+    ep = mesh.shape["ep"]
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if _spec_for_path(path, leaf) != P():
+            # fail up front with a readable message, not deep inside
+            # XLA's partitioner (mirrors the depth%pp / seq_len%sp guards)
+            assert leaf.shape[0] % ep == 0, (
+                f"moe_experts={leaf.shape[0]} must divide over the mesh "
+                f"ep axis ({ep})")
+            break
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _spec_for_path(path, leaf)),
+        state)

@@ -10,7 +10,10 @@ chips with two axes —
   is dp-sharded and params are replicated);
 - ``mp`` (model parallel): tensor-sharded layers on models wide enough to
   pay for it — the DTQN FFN is Megatron-split over this axis when
-  ``mp_size > 1`` (parallel/tensor_parallel.py).
+  ``mp_size > 1`` (parallel/tensor_parallel.py);
+- ``ep`` (expert parallel): MoE expert kernels shard their leading expert
+  dim over it, the combine einsum closing with a psum over ep
+  (models/moe.py + parallel/expert_parallel.py).
 
 Multi-host pods: call ``jax.distributed.initialize`` first
 (``init_multihost``), then the same mesh code spans all hosts' devices —
@@ -27,30 +30,38 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def make_mesh(dp_size: int = -1, mp_size: int = 1, sp_size: int = 1,
-              devices=None) -> Mesh:
+              ep_size: int = 1, pp_size: int = 1, devices=None) -> Mesh:
     """Logical mesh over the chips: ``dp`` (data parallel), ``sp``
     (sequence/context parallel — ring attention shards the time axis over
-    it, ops/ring_attention.py) and ``mp`` (tensor parallel)."""
+    it, ops/ring_attention.py), ``mp`` (tensor parallel), ``ep``
+    (expert parallel — MoE expert kernels shard over it,
+    parallel/expert_parallel.py) and ``pp`` (pipeline parallel — stacked
+    transformer blocks shard their layer axis over it and microbatches
+    flow stage-to-stage via ppermute, parallel/pipeline.py)."""
     explicit = devices is not None
     devices = list(devices if explicit else jax.devices())
     n = len(devices)
+    model_axes = mp_size * sp_size * ep_size * pp_size
     if dp_size == -1:
-        assert n % (mp_size * sp_size) == 0, (
-            f"{n} devices not divisible by mp*sp={mp_size * sp_size}")
-        dp_size = n // (mp_size * sp_size)
-    used = dp_size * mp_size * sp_size
+        assert n % model_axes == 0, (
+            f"{n} devices not divisible by mp*sp*ep*pp={model_axes}")
+        dp_size = n // model_axes
+    used = dp_size * model_axes
     assert used <= n, (
-        f"mesh {dp_size}x{sp_size}x{mp_size} needs more than {n} devices")
+        f"mesh dp{dp_size}xsp{sp_size}xmp{mp_size}xep{ep_size}xpp{pp_size}"
+        f" needs more than {n} devices")
     if used < n and not explicit:
         # an undersized explicit mesh over the default device set silently
         # strands chips — make the throughput loss visible
         import warnings
 
         warnings.warn(
-            f"mesh {dp_size}x{sp_size}x{mp_size} uses {used} of {n} available "
-            f"devices; {n - used} chip(s) idle", stacklevel=2)
-    grid = np.array(devices[:used]).reshape(dp_size, sp_size, mp_size)
-    return Mesh(grid, ("dp", "sp", "mp"))
+            f"mesh dp{dp_size}xsp{sp_size}xmp{mp_size}xep{ep_size}"
+            f"xpp{pp_size} uses {used} of {n} available devices; "
+            f"{n - used} chip(s) idle", stacklevel=2)
+    grid = np.array(devices[:used]).reshape(dp_size, sp_size, mp_size,
+                                            ep_size, pp_size)
+    return Mesh(grid, ("dp", "sp", "mp", "ep", "pp"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

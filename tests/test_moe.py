@@ -1,0 +1,261 @@
+"""Expert-parallel (ep axis) tests: the MoE DTQN must route correctly,
+match its own replicated math when the experts shard over ep, and plug
+into the r2d2 learner contract unchanged (models/moe.py,
+parallel/expert_parallel.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.memory.sequence_replay import SegmentBatch
+from pytorch_distributed_tpu.models.moe import (
+    AUX_COLLECTION, DtqnMoeModel, MoeFfn, _top_k_dispatch, window_q_with_aux,
+)
+from pytorch_distributed_tpu.ops.losses import (
+    init_train_state, make_optimizer,
+)
+from pytorch_distributed_tpu.ops.sequence_losses import build_dtqn_train_step
+from pytorch_distributed_tpu.parallel.expert_parallel import (
+    moe_state_shardings,
+)
+from pytorch_distributed_tpu.parallel.learner import ShardedLearner
+from pytorch_distributed_tpu.parallel.mesh import make_mesh
+
+
+def test_dispatch_assigns_unique_slots_and_respects_capacity():
+    rng = np.random.default_rng(0)
+    B, T, E, k, C = 3, 16, 4, 2, 5
+    probs = jax.nn.softmax(
+        jnp.asarray(rng.normal(size=(B, T, E)).astype(np.float32)))
+    dispatch, combine, f_top1 = _top_k_dispatch(probs, k, C)
+    d = np.asarray(dispatch)
+    # a slot holds at most one token
+    assert np.max(np.sum(d, axis=1)) <= 1.0 + 1e-6
+    # a token claims at most one slot per expert, k slots total
+    assert np.max(np.sum(d, axis=3)) <= 1.0 + 1e-6
+    assert np.max(np.sum(d, axis=(2, 3))) <= k + 1e-6
+    # combine weights live exactly on dispatched slots and a fully-kept
+    # token's gates sum to 1 (renormalised over its k choices)
+    c = np.asarray(combine)
+    assert np.all(c[d == 0] == 0)
+    per_token = np.sum(c, axis=(2, 3))
+    kept_all = np.sum(d, axis=(2, 3)) == k
+    np.testing.assert_allclose(per_token[kept_all], 1.0, rtol=1e-5)
+    # rank-0 mask is one-hot per token
+    np.testing.assert_allclose(np.sum(np.asarray(f_top1), -1), 1.0)
+
+
+def test_dispatch_drops_overflow_deterministically():
+    # all tokens pick expert 0 at rank 0: only the first C survive there
+    B, T, E, C = 1, 8, 2, 3
+    probs = jnp.tile(jnp.asarray([[0.9, 0.1]], jnp.float32), (T, 1))[None]
+    dispatch, _, _ = _top_k_dispatch(probs, 1, C)
+    d = np.asarray(dispatch)[0]            # (T, E, C)
+    assert np.sum(d[:, 0]) == C            # capacity filled...
+    assert np.all(np.sum(d[:C, 0], axis=1) == 1)   # ...by the earliest
+    assert np.all(d[C:, 0] == 0)           # later tokens dropped
+
+
+def test_single_expert_reduces_to_dense_ffn():
+    """E=1, k=1, ample capacity: the mixture must equal the plain FFN
+    computed from its own expert kernels — routing becomes the identity."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 6, 8)).astype(np.float32))
+    ffn = MoeFfn(dim=8, num_experts=1, top_k=1, capacity_factor=1.0)
+    params = ffn.init(jax.random.PRNGKey(0), x)
+    y, aux = ffn.apply(params, x)
+    p = params["params"]
+    ref = jax.nn.gelu(x @ p["w1"][0] + p["b1"][0]) @ p["w2"][0] + p["b2"][0]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # one expert: f=1, P=1 -> aux == 1 exactly
+    np.testing.assert_allclose(float(aux), 1.0, rtol=1e-6)
+
+
+def test_aux_loss_prefers_balance():
+    """The Switch aux term is minimised (=1) by uniform routing and grows
+    when the router collapses onto one expert."""
+    B, T, E = 2, 12, 4
+    uniform = jnp.full((B, T, E), 1.0 / E)
+    _, _, f_u = _top_k_dispatch(uniform, 1, T)
+    aux_u = E * float(jnp.sum(jnp.mean(f_u, (0, 1)) * jnp.mean(uniform,
+                                                               (0, 1))))
+    skew = jax.nn.softmax(
+        jnp.tile(jnp.asarray([8.0, 0.0, 0.0, 0.0]), (B, T, 1)))
+    _, _, f_s = _top_k_dispatch(skew, 1, T)
+    aux_s = E * float(jnp.sum(jnp.mean(f_s, (0, 1)) * jnp.mean(skew,
+                                                               (0, 1))))
+    assert abs(aux_u - 1.0) < 1e-5
+    assert aux_s > 2.0
+
+
+def _setup(T=8, B=4, obs_dim=6, actions=4, aux_weight=0.01):
+    model = DtqnMoeModel(action_space=actions, state_shape=(obs_dim,),
+                         window=T, dim=32, heads=4, depth=2, norm_val=1.0,
+                         num_experts=8, top_k=2, capacity_factor=1.25)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, obs_dim)))
+    params = {"params": variables["params"]}
+    tx = make_optimizer(lr=1e-3)
+    state = init_train_state(params, tx)
+    step = build_dtqn_train_step(
+        window_q_with_aux(model), tx, burn_in=0, nstep=3, gamma=0.99,
+        enable_double=True, target_model_update=100, aux_weight=aux_weight)
+    L = T - 1
+    rng = np.random.default_rng(7)
+    batch = SegmentBatch(
+        obs=rng.normal(size=(B, T, obs_dim)).astype(np.float32),
+        action=rng.integers(0, actions, size=(B, L)).astype(np.int32),
+        reward=rng.normal(size=(B, L)).astype(np.float32),
+        terminal=np.zeros((B, L), dtype=np.float32),
+        mask=np.ones((B, L), dtype=np.float32),
+        c0=np.zeros((B, 1), dtype=np.float32),
+        h0=np.zeros((B, 1), dtype=np.float32),
+        weight=np.ones(B, dtype=np.float32),
+        index=np.arange(B, dtype=np.int32),
+    )
+    return model, state, step, batch
+
+
+def test_expert_kernels_shard_over_ep():
+    mesh = make_mesh(dp_size=2, ep_size=4)
+    _, state, _, _ = _setup()
+    sh = moe_state_shardings(state, mesh)
+    flat = jax.tree_util.tree_flatten_with_path(sh)[0]
+    expert = [(p, s) for p, s in flat
+              if "moe" in str(p) and any(f"'{n}'" in str(p)
+                                         for n in ("w1", "w2"))]
+    # depth=2 blocks x >=3 trees (params, target, adam moments)
+    assert len(expert) >= 4
+    for p, s in expert:
+        assert s.spec[0] == "ep", (p, s.spec)
+    routers = [s for p, s in flat if "router" in str(p)]
+    assert routers and all(
+        s.spec == jax.sharding.PartitionSpec() for s in routers)
+
+
+def test_ep_sharded_step_matches_replicated():
+    """One full train step (fwd+bwd+Adam+target) on a dp2 x ep4 mesh:
+    expert-sharded MoE == replicated math, and the placed kernels really
+    live split over ep."""
+    mesh = make_mesh(dp_size=2, ep_size=4)
+    _, state, step, batch = _setup()
+
+    ref = ShardedLearner(step, mesh, donate=False)
+    s0 = ref.place(state)
+    s0, m0, td0 = ref.step(s0, batch)
+
+    sh = moe_state_shardings(state, mesh)
+    ep = ShardedLearner(step, mesh, donate=False, state_shardings=sh)
+    s1 = ep.place(state)
+    kernels = [
+        (path, leaf) for path, leaf
+        in jax.tree_util.tree_flatten_with_path(s1.params)[0]
+        if "moe" in str(path) and "'w1'" in str(path)]
+    assert kernels
+    for _, leaf in kernels:
+        assert leaf.sharding.spec[0] == "ep"
+    s1, m1, td1 = ep.step(s1, batch)
+
+    np.testing.assert_allclose(
+        float(m1["learner/critic_loss"]), float(m0["learner/critic_loss"]),
+        rtol=1e-4, atol=1e-5)
+    assert "learner/moe_aux" in m1
+    assert float(m1["learner/moe_aux"]) >= 1.0 - 1e-4
+    np.testing.assert_allclose(np.asarray(td1), np.asarray(td0),
+                               rtol=1e-3, atol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(s0.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(s1.params))):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_acting_path_matches_window_q_tail():
+    """The MoE model honours the DTQN acting contract: feeding a sequence
+    step-by-step through __call__ yields the same Q for the newest
+    observation as one window_q pass over the filled prefix."""
+    model, state, _, batch = _setup(T=8)
+    params = state.params
+    obs = batch.obs[:2]                     # (2, 8, 6)
+    carry = model.zero_carry(2)
+    apply = jax.jit(lambda p, o, c: model.apply(p, o, c))
+    for t in range(4):
+        q_act, carry = apply(params, obs[:, t], carry)
+    q_win = model.apply(params, obs[:, :4], method=model.window_q)
+    np.testing.assert_allclose(np.asarray(q_act), np.asarray(q_win[:, 3]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_init_time_sown_aux_cannot_leak_into_params():
+    """flax init captures the sown moe_losses collection; if those leaves
+    ride inside TrainState.params they seed every later sow reduce and
+    become free parameters with a constant positive aux gradient (Adam
+    would drive them unboundedly negative).  Both defenses hold: factory
+    init strips them, and window_q_with_aux ignores them when present."""
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.factory import (
+        build_model, init_params, probe_env,
+    )
+
+    opt = build_options(17, seq_len=7, burn_in=0)
+    spec = probe_env(opt)
+    model = build_model(opt, spec)
+    params = init_params(opt, spec, model, seed=0)
+    assert set(params.keys()) == {"params"}
+
+    # direct-init callers: a variables dict still carrying the collection
+    # must produce the SAME aux as the clean one
+    obs_dim = spec.state_shape[0]
+    dirty = model.init(jax.random.PRNGKey(0), jnp.zeros((1, obs_dim)))
+    assert AUX_COLLECTION in dirty
+    # poison the stored leaves: if they seeded the reduce, aux would shift
+    poisoned = dict(dirty)
+    poisoned[AUX_COLLECTION] = jax.tree_util.tree_map(
+        lambda x: x - 1000.0, dirty[AUX_COLLECTION])
+    apply = window_q_with_aux(model)
+    obs = jnp.asarray(np.random.default_rng(0).normal(
+        size=(2, 8, obs_dim)).astype(np.float32))
+    _, aux_clean = apply({"params": dirty["params"]}, obs)
+    _, aux_dirty = apply(poisoned, obs)
+    np.testing.assert_allclose(float(aux_dirty), float(aux_clean),
+                               rtol=1e-6)
+    assert float(aux_clean) >= 1.0 - 1e-5
+
+
+def test_factory_builds_moe_row_and_step_runs():
+    """CONFIGS row 17 constructs end-to-end: model, params, train step
+    with the aux term, one update on synthetic segments."""
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.factory import (
+        build_model, build_train_state_and_step, init_params, probe_env,
+    )
+
+    opt = build_options(17, seq_len=7, burn_in=0)
+    assert opt.model_type == "dtqn-moe"
+    spec = probe_env(opt)
+    model = build_model(opt, spec)
+    assert isinstance(model, DtqnMoeModel)
+    params = init_params(opt, spec, model, seed=0)
+    state, step = build_train_state_and_step(opt, spec, model, params)
+    T = opt.agent_params.seq_len + 1
+    L = T - 1
+    rng = np.random.default_rng(3)
+    B = 4
+    batch = SegmentBatch(
+        obs=rng.normal(size=(B, T, *spec.state_shape)).astype(np.float32),
+        action=rng.integers(0, spec.num_actions, size=(B, L)).astype(
+            np.int32),
+        reward=rng.normal(size=(B, L)).astype(np.float32),
+        terminal=np.zeros((B, L), dtype=np.float32),
+        mask=np.ones((B, L), dtype=np.float32),
+        c0=np.zeros((B, 1), dtype=np.float32),
+        h0=np.zeros((B, 1), dtype=np.float32),
+        weight=np.ones(B, dtype=np.float32),
+        index=np.arange(B, dtype=np.int32),
+    )
+    state, metrics, pr = jax.jit(step)(state, batch)
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["learner/critic_loss"]))
+    assert float(metrics["learner/moe_aux"]) >= 1.0 - 1e-4
+    assert pr.shape == (B,)
