@@ -81,8 +81,11 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     if mesh is not None and pp.mp_size > 1:
         # the one family wide enough for tensor parallelism: Megatron-split
         # DTQN FFN over mp (parallel/tensor_parallel.py)
-        assert "dtqn" in opt.model_type, (
-            f"mp_size>1 is only supported for dtqn models "
+        # exact match: the moe/pipe families have no _Block_ param paths,
+        # so dtqn_state_shardings would silently no-op on them (their
+        # splits are ep and pp respectively)
+        assert opt.model_type == "dtqn-mlp", (
+            f"mp_size>1 is only supported for dtqn-mlp "
             f"(got {opt.model_type})")
         from pytorch_distributed_tpu.parallel.tensor_parallel import (
             dtqn_state_shardings,

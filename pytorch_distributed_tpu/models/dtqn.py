@@ -35,6 +35,32 @@ from pytorch_distributed_tpu.ops.ring_attention import full_attention
 Carry = Tuple[jnp.ndarray, jnp.ndarray]  # (window (B,W,*S) f32, filled (B,))
 
 
+def embed_tokens(m: nn.Module, win: jnp.ndarray) -> jnp.ndarray:
+    """Shared DTQN torso (norm -> flatten -> Dense embed -> learned
+    positions), used by the dense and MoE families' compact ``_encode``
+    so the exact acting/training position contract cannot drift.
+    (The pipeline family re-expresses the same two lines setup-style on
+    named submodules — models/dtqn_pipeline.py ``embed``.)  Must be
+    called first inside the caller's compact method: submodules register
+    under the caller, keeping historical auto-names."""
+    B, T = win.shape[0], win.shape[1]
+    x = win.astype(jnp.float32) / m.norm_val
+    x = x.reshape(B, T, -1)
+    x = nn.Dense(m.dim)(x)
+    return x + m.param("pos_embed", nn.initializers.normal(0.02),
+                       (m.window, m.dim))[:T]
+
+
+def q_head(m: nn.Module, x: jnp.ndarray) -> jnp.ndarray:
+    """Shared DTQN head: final LayerNorm + ZERO-INIT Q projection — Q
+    starts exactly at 0, so the max-bias of early bootstrapping has
+    nothing optimistic to amplify; without this the online loop can
+    drift onto a flat inflated plateau on sparse-reward envs (tiny TD
+    loss, useless greedy policy)."""
+    x = nn.LayerNorm()(x)
+    return nn.Dense(m.action_space, kernel_init=nn.initializers.zeros)(x)
+
+
 def attention_half(block: nn.Module, x: jnp.ndarray,
                    pad_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
     """The attention residual of a pre-LN block — shared by the dense
@@ -111,21 +137,10 @@ class DtqnMlpModel(nn.Module):
     @nn.compact
     def _encode(self, win: jnp.ndarray,
                 pad_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
-        B, T = win.shape[0], win.shape[1]
-        x = win.astype(jnp.float32) / self.norm_val
-        x = x.reshape(B, T, -1)
-        x = nn.Dense(self.dim)(x)
-        x = x + self.param("pos_embed", nn.initializers.normal(0.02),
-                           (self.window, self.dim))[:T]
+        x = embed_tokens(self, win)
         for _ in range(self.depth):
             x = _Block(self.dim, self.heads, self.attn)(x, pad_mask)
-        x = nn.LayerNorm()(x)
-        # zero-init head: Q starts exactly at 0, so the max-bias of early
-        # bootstrapping has nothing optimistic to amplify — without this
-        # the online loop can drift onto a flat inflated plateau on
-        # sparse-reward envs (tiny TD loss, useless greedy policy)
-        return nn.Dense(self.action_space,
-                        kernel_init=nn.initializers.zeros)(x)  # (B, T, A)
+        return q_head(self, x)  # (B, T, A)
 
     def __call__(self, obs: jnp.ndarray, carry: Optional[Carry] = None
                  ) -> Tuple[jnp.ndarray, Carry]:

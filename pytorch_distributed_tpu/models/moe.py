@@ -42,7 +42,9 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from pytorch_distributed_tpu.models.dtqn import DtqnMlpModel, attention_half
+from pytorch_distributed_tpu.models.dtqn import (
+    DtqnMlpModel, attention_half, embed_tokens, q_head,
+)
 
 AUX_COLLECTION = "moe_losses"
 
@@ -177,21 +179,12 @@ class DtqnMoeModel(DtqnMlpModel):
     @nn.compact
     def _encode(self, win: jnp.ndarray,
                 pad_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
-        B, T = win.shape[0], win.shape[1]
-        x = win.astype(jnp.float32) / self.norm_val
-        x = x.reshape(B, T, -1)
-        x = nn.Dense(self.dim)(x)
-        x = x + self.param("pos_embed", nn.initializers.normal(0.02),
-                           (self.window, self.dim))[:T]
+        x = embed_tokens(self, win)
         for _ in range(self.depth):
             x = _MoeBlock(self.dim, self.heads, self.num_experts,
                           self.top_k, self.capacity_factor,
                           self.attn)(x, pad_mask)
-        x = nn.LayerNorm()(x)
-        # zero-init head for the same bootstrapping reason as the dense
-        # DTQN (models/dtqn.py::_encode)
-        return nn.Dense(self.action_space,
-                        kernel_init=nn.initializers.zeros)(x)
+        return q_head(self, x)
 
 
 def window_q_with_aux(model: DtqnMoeModel):
