@@ -54,9 +54,15 @@ def _child_main(role: str, agent_type: str, args: tuple) -> None:
     lazily, so flipping the config here is safe even though modules were
     imported during unpickling."""
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # CPU-backend processes never use the persistent compile cache: the
+    # CPU AOT loader can nondeterministically SIGABRT re-loaded
+    # multi-device programs (utils/helpers.enable_compile_cache), and a
+    # TPU parent's cache env var would otherwise leak in here
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", None)
     get_worker(role, agent_type)(*args)
 
 

@@ -50,19 +50,33 @@ def update_target(target: PyTree, online: PyTree, step: jnp.ndarray,
     return periodic_update(target, online, step, int(target_model_update))
 
 
-def enable_compile_cache(cache_dir: str | None = None) -> str:
+def enable_compile_cache(cache_dir: str | None = None) -> str | None:
     """Turn on JAX's persistent XLA compile cache for this process AND its
-    spawned workers.
+    spawned workers — TPU platform only.
 
     Both halves are load-bearing: ``jax.config.update`` flips the already-
     imported jax in this process (the env var alone is too late once
     sitecustomize pre-imported jax), while the env var is inherited by
     spawn children whose fresh jax import reads it.  Repeated drives on a
     tunnelled chip otherwise pay minutes of identical remote compiles per
-    process."""
+    process.
+
+    On the CPU backend this is a NO-OP: XLA's CPU AOT loader can
+    nondeterministically SIGABRT when re-loading cached executables of
+    collective-dense multi-device programs (feature-string mismatch the
+    loader itself warns about; A/B-reproduced 2026-07-31 — 3/8 aborts
+    with cache vs 0/22 without on the pp pipeline step).  TPU cache
+    entries are TPU executables that never cross that loader."""
     import os
     import tempfile
 
+    if jax.devices()[0].platform != "tpu":
+        # make sure spawn children don't re-enable it either, AND kill it
+        # in this process too — an ambient env var set before jax import
+        # has already landed in the live config
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        jax.config.update("jax_compilation_cache_dir", None)
+        return None
     cache_dir = os.environ.setdefault(
         "JAX_COMPILATION_CACHE_DIR",
         cache_dir or os.path.join(tempfile.gettempdir(), "pdtpu_xla_cache"))

@@ -22,13 +22,21 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# Persistent XLA compile cache: the suite's expensive compiles (ring/
-# Ulysses shard_map programs, CNN train steps) are identical across runs;
-# caching them cuts several minutes off every rerun.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      "/tmp/pdtpu_xla_cache_tests")
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+# NO persistent XLA compile cache on the CPU backend, on purpose: XLA's
+# CPU AOT loader warns that cached executables were compiled with
+# pseudo-features (+prefer-no-gather/-scatter) its host-feature check
+# can't match, and for the suite's collective-dense multi-device
+# programs (the pp pipeline step above all) the warning is REAL — with
+# the cache enabled the AOT-loaded executable nondeterministically
+# SIGABRTs the whole pytest process (~25% of runs, reproduced 2026-07-31
+# with an 8-run A/B: 3/8 aborts with cache, 0/22 without).  The suite
+# pays fresh compiles instead; utils/helpers.enable_compile_cache keeps
+# the cache for TPU-platform processes, whose entries are TPU
+# executables that never cross the CPU AOT loader.  Enforced, not just
+# unset: an ambient env var (e.g. exported by a TPU drive's shell)
+# would otherwise silently re-enable it here and in every spawn child.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+jax.config.update("jax_compilation_cache_dir", None)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
