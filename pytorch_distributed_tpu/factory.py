@@ -429,6 +429,10 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
 
                 window_apply = window_q_with_aux(train_model)
                 kw["aux_weight"] = opt.model_params.moe_aux_weight
+                # target pass: q only — no mutable sow collection; the
+                # frozen network's aux value is never used
+                kw["target_window_apply"] = lambda p, obs: \
+                    train_model.apply(p, obs, method=train_model.window_q)
             else:
                 window_apply = lambda p, obs: train_model.apply(
                     p, obs, method=train_model.window_q)

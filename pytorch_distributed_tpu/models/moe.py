@@ -102,13 +102,21 @@ class MoeFfn(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     hidden_mult: int = 4
+    # Static token count the capacity is derived from.  When set (the DTQN
+    # models pass their static ``window``) routing is length-invariant:
+    # the same params route a 4-token prefix and the padded acting window
+    # identically.  Deriving it from the runtime x.shape[1] made capacity
+    # — and hence overflow-drop behaviour — depend on input length
+    # (round-2 advisor finding).
+    capacity_tokens: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         B, T, D = x.shape
         E, k = self.num_experts, min(self.top_k, self.num_experts)
         H = self.hidden_mult * self.dim
-        capacity = max(int(-(-self.capacity_factor * k * T // E)), 1)
+        cap_T = self.capacity_tokens if self.capacity_tokens else T
+        capacity = max(int(-(-self.capacity_factor * k * cap_T // E)), 1)
 
         logits = nn.Dense(E, name="router")(x)            # (B, T, E)
         probs = jax.nn.softmax(logits, axis=-1)
@@ -150,6 +158,7 @@ class _MoeBlock(nn.Module):
     top_k: int
     capacity_factor: float
     attn: Optional[object] = None
+    capacity_tokens: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
@@ -157,7 +166,9 @@ class _MoeBlock(nn.Module):
         x = attention_half(self, x, pad_mask)
         y = nn.LayerNorm()(x)
         ffn_out, _ = MoeFfn(self.dim, self.num_experts, self.top_k,
-                            self.capacity_factor, name="moe")(y)
+                            self.capacity_factor,
+                            capacity_tokens=self.capacity_tokens,
+                            name="moe")(y)
         return x + ffn_out
 
 
@@ -183,7 +194,8 @@ class DtqnMoeModel(DtqnMlpModel):
         for _ in range(self.depth):
             x = _MoeBlock(self.dim, self.heads, self.num_experts,
                           self.top_k, self.capacity_factor,
-                          self.attn)(x, pad_mask)
+                          self.attn,
+                          capacity_tokens=self.window)(x, pad_mask)
         return q_head(self, x)
 
 

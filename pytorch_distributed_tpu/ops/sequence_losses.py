@@ -219,6 +219,7 @@ def build_dtqn_train_step(
     priority_eta: float = 0.9,
     axis_name: str | None = None,
     aux_weight: float = 0.0,
+    target_window_apply: Callable | None = None,
 ) -> Callable[[TrainState, SegmentBatch],
               Tuple[TrainState, Dict[str, jnp.ndarray], jnp.ndarray]]:
     """Transformer (DTQN) sequence update: same contract as
@@ -231,7 +232,10 @@ def build_dtqn_train_step(
     MoE models (models/moe.py) pass a ``window_apply`` returning
     ``(q, aux)`` instead — the auxiliary load-balancing loss joins the TD
     loss with weight ``aux_weight`` and surfaces as
-    ``learner/moe_aux``."""
+    ``learner/moe_aux``.  ``target_window_apply``, when given, evaluates
+    the target-network pass — MoE passes a q-only apply here so the
+    frozen pass skips the mutable sow collection whose aux value is
+    discarded anyway (round-2 advisor finding)."""
 
     h = value_rescale if rescale_values else (lambda x: x)
     h_inv = value_unrescale if rescale_values else (lambda x: x)
@@ -241,12 +245,17 @@ def build_dtqn_train_step(
         # tuple-vs-array is static python structure, resolved at trace time
         return out if isinstance(out, tuple) else (out, jnp.float32(0.0))
 
+    def target_apply(params, obs):
+        if target_window_apply is not None:
+            return target_window_apply(params, obs)
+        return split_apply(params, obs)[0]
+
     def step(state: TrainState, batch: SegmentBatch):
         T = batch.action.shape[1]
         train_len = T - burn_in
         # (L+1, B, A) over the train window, burn-in kept as context
         to_tm = lambda q: jnp.moveaxis(q, 0, 1)[burn_in:]
-        q_target_tm = to_tm(split_apply(state.target_params, batch.obs)[0])
+        q_target_tm = to_tm(target_apply(state.target_params, batch.obs))
 
         a_tm = jnp.moveaxis(batch.action, 0, 1)[burn_in:]
         r_tm = jnp.moveaxis(batch.reward, 0, 1)[burn_in:]
