@@ -109,9 +109,10 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
 
     version = 0
     params = None
+    best_reward = float("-inf")
 
     def evaluate() -> None:
-        nonlocal version, params
+        nonlocal version, params, best_reward
         got = param_store.fetch(version)
         if got is not None:
             flat, version = got
@@ -131,6 +132,15 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         )
         # the params-only checkpoint (reference evaluators.py:97-100)
         ckpt.save_params(ckpt.params_path(opt.model_name), params)
+        # best-so-far tier (no reference equivalent): value curves dip —
+        # DQN evals can transiently collapse right after a peak — and the
+        # latest-params tier alone would let a run that ends mid-dip
+        # overwrite its own best policy.  <refs>_best.msgpack always
+        # holds the weights of the highest eval so far.
+        if avg_reward > best_reward:
+            best_reward = avg_reward
+            ckpt.save_params(
+                ckpt.params_path(opt.model_name + "_best"), params)
 
     try:
         last_eval = 0.0  # evaluate immediately once weights exist

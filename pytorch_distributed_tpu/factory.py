@@ -445,8 +445,14 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
         tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay,
                             lr_decay_steps=decay)
         state = init_train_state(params, tx)
+        train_apply = model.apply
+        if device_ring_channels_last(opt):
+            # the HBM ring stores rows NHWC (same param tree, transpose
+            # moved from 3x per update to once per ingest — see
+            # memory/device_replay.py chunk_to_nhwc)
+            train_apply = model.clone(nhwc_input=True).apply
         step = build_dqn_train_step(
-            model.apply, tx,
+            train_apply, tx,
             enable_double=ap.enable_double,
             target_model_update=ap.target_model_update,
         )
@@ -505,6 +511,30 @@ class MemoryHandles:
 
     actor_side: Any
     learner_side: Any
+
+
+def device_ring_channels_last(opt: Options) -> bool:
+    """Whether the HBM ring stores image rows channels-last (NHWC).
+
+    Decided here so build_memory (ring geometry, parent process) and
+    build_train_state_and_step (the NHWC train apply, learner process)
+    always agree.  Currently ALWAYS False, from measurement, not
+    oversight: the XLA profile showed ~25% of fused-step device time in
+    layout copies, but an interleaved A/B on the TPU v5 lite (2026-07-31,
+    tools/mfu_probe.py machinery) measured the channels-last ring ~13%
+    SLOWER (2078 -> 1807 updates/s) — TPU tiled layouts pad the minor
+    dimension to the 128 vector lanes, so (..., 84, 4) rows pad the
+    4-wide channel axis brutally while the NCHW profile's copies are
+    XLA's own (cheaper) preferred re-tilings.  The mechanism stays
+    (DeviceReplay channels_last + DqnCnnModel nhwc_input, layout-
+    equivalence-tested) for hardware where the trade flips — and this
+    predicate carries ALL the eligibility conditions (fused device ring
+    + the CNN model that owns an nhwc_input switch), so flipping the
+    final ``False`` to a measurement is the whole change: host-replay
+    configs and MLP models can never see the NHWC apply."""
+    eligible = (opt.memory_type in ("device", "device-per")
+                and opt.model_type == "dqn-cnn")
+    return eligible and False  # False by measurement (see docstring)
 
 
 def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
@@ -582,6 +612,7 @@ def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
             action_shape=spec.action_shape,
             state_dtype=state_dtype,
             action_dtype=spec.action_dtype,
+            channels_last=device_ring_channels_last(opt),
         )
         if opt.memory_type == "device-per":
             ingest = DevicePerIngest(

@@ -118,13 +118,15 @@ class DevicePerReplay(DeviceReplay):
                  priority_exponent: float = 0.6,
                  importance_weight: float = 0.4,
                  importance_anneal_steps: int = 500000,
-                 mesh: Optional[jax.sharding.Mesh] = None):
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 channels_last: bool = False):
         self.alpha = priority_exponent
         self.beta0 = importance_weight
         self.beta_steps = importance_anneal_steps
         super().__init__(round_capacity(capacity, mesh, label="device PER"),
                          state_shape, action_shape, state_dtype,
-                         action_dtype, mesh=mesh)
+                         action_dtype, mesh=mesh,
+                         channels_last=channels_last)
 
         # Pallas hierarchical sampler on unsharded TPU rings; the flat XLA
         # scheme everywhere else (dp-sharded rings address rows through
@@ -139,9 +141,14 @@ class DevicePerReplay(DeviceReplay):
 
             self._draw_fn = hierarchical_sample
 
-        self._feed_fn = jax.jit(
-            functools.partial(per_feed, capacity=self.capacity),
-            donate_argnums=0)
+        feed = functools.partial(per_feed, capacity=self.capacity)
+        if self.channels_last:
+            from pytorch_distributed_tpu.memory.device_replay import (
+                wrap_feed_nhwc,
+            )
+
+            feed = wrap_feed_nhwc(feed)
+        self._feed_fn = jax.jit(feed, donate_argnums=0)
         self._sample_fn = jax.jit(
             functools.partial(per_sample, sample_fn=self._draw_fn),
             static_argnames="batch_size")
@@ -200,6 +207,12 @@ class DevicePerReplay(DeviceReplay):
         out = {k: np.roll(np.asarray(getattr(st, k)), shift,
                           axis=0)[:fill].copy()
                for k in Transition._fields}
+        if self.channels_last:  # public schema is NCHW (see DeviceReplay)
+            from pytorch_distributed_tpu.memory.device_replay import (
+                snapshot_states_to_nchw,
+            )
+
+            out = snapshot_states_to_nchw(out)
         out["leaf_priority"] = np.roll(
             np.asarray(st.priority), shift)[:fill].copy()
         # stored p^alpha on device; snapshot in the shared UNexponentiated

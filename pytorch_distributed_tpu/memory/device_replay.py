@@ -62,6 +62,33 @@ def _feed(state: ReplayState, chunk: Transition, capacity: int) -> ReplayState:
     return ring_write(state, chunk, capacity)[0]
 
 
+def chunk_to_nhwc(chunk: Transition) -> Transition:
+    """Transpose a chunk's (N, C, H, W) states to (N, H, W, C) — runs
+    inside the jitted feed, so a channels-last ring pays the layout copy
+    ONCE per ingested row instead of every time the row is sampled (each
+    row is trained on ~replay_ratio times, and each update runs 3 CNN
+    forwards that each needed the copy: ~25% of device time in the XLA
+    profile, tools/mfu_probe.py)."""
+    t = lambda x: jnp.transpose(x, (0, 2, 3, 1))
+    return chunk._replace(state0=t(chunk.state0), state1=t(chunk.state1))
+
+
+def wrap_feed_nhwc(feed_fn):
+    """Single point wrapping a ring's feed with the ingest transpose —
+    DeviceReplay and DevicePerReplay share it so the layout contract
+    lives in one place."""
+    return lambda st, ch: feed_fn(st, chunk_to_nhwc(ch))
+
+
+def snapshot_states_to_nchw(out: dict) -> dict:
+    """Roll a channels-last snapshot's states back to the public NCHW
+    schema (checkpoints are layout-independent); shared by both ring
+    classes."""
+    for k in ("state0", "state1"):
+        out[k] = np.ascontiguousarray(np.transpose(out[k], (0, 3, 1, 2)))
+    return out
+
+
 def round_capacity(capacity: int, mesh: Optional[jax.sharding.Mesh],
                    axis: str = "dp", label: str = "device replay") -> int:
     """Round capacity up to a multiple of the mesh axis so ring rows split
@@ -134,7 +161,7 @@ class DeviceReplay:
                  action_shape: Tuple[int, ...] = (),
                  state_dtype=np.uint8, action_dtype=np.int32,
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 axis: str = "dp"):
+                 axis: str = "dp", channels_last: bool = False):
         self.capacity = capacity
         self.state_shape = tuple(state_shape)
         self.action_shape = tuple(action_shape)
@@ -142,6 +169,13 @@ class DeviceReplay:
         self.action_dtype = jnp.dtype(action_dtype)
         self.mesh = mesh
         self.axis = axis
+        # channels-last storage: rows live as (H, W, C) so the fused
+        # sampler hands the CNN NHWC batches directly (model nhwc_input);
+        # feeds transpose on device at ingest (chunk_to_nhwc), snapshots
+        # roll back to the public NCHW schema
+        self.channels_last = bool(channels_last and len(state_shape) == 3)
+        self._store_shape = (tuple(state_shape[1:]) + (state_shape[0],)
+                             if self.channels_last else tuple(state_shape))
 
         if mesh is not None:
             ndev = mesh.shape[axis]
@@ -156,8 +190,10 @@ class DeviceReplay:
             self._scalar_sharding = None
 
         self.state = self._init_state()
-        self._feed_fn = jax.jit(
-            functools.partial(_feed, capacity=capacity), donate_argnums=0)
+        feed = functools.partial(_feed, capacity=capacity)
+        if self.channels_last:
+            feed = wrap_feed_nhwc(feed)
+        self._feed_fn = jax.jit(feed, donate_argnums=0)
         self._sample_fn = jax.jit(
             sample_rows, static_argnames="batch_size", donate_argnums=())
 
@@ -173,11 +209,11 @@ class DeviceReplay:
         N = self.capacity
         alloc = self._alloc
         return ReplayState(
-            state0=alloc((N, *self.state_shape), self.state_dtype),
+            state0=alloc((N, *self._store_shape), self.state_dtype),
             action=alloc((N, *self.action_shape), self.action_dtype),
             reward=alloc((N,), jnp.float32),
             gamma_n=alloc((N,), jnp.float32),
-            state1=alloc((N, *self.state_shape), self.state_dtype),
+            state1=alloc((N, *self._store_shape), self.state_dtype),
             terminal1=alloc((N,), jnp.float32),
             pos=alloc((), jnp.int32, sharded=False),
             fill=alloc((), jnp.int32, sharded=False),
@@ -192,13 +228,17 @@ class DeviceReplay:
     def snapshot(self) -> dict:
         """Pull the valid HBM rows to host in AGE order (when full, the
         cursor points at the oldest row; before that, [0, fill) is already
-        oldest-first)."""
+        oldest-first).  Channels-last rings roll back to the public NCHW
+        schema so checkpoints are layout-independent."""
         st = jax.device_get(self.state)
         fill, pos = int(st.fill), int(st.pos)
         shift = -pos if fill == self.capacity else 0
-        return {k: np.roll(np.asarray(getattr(st, k)), shift,
-                           axis=0)[:fill].copy()
-                for k in Transition._fields}
+        out = {k: np.roll(np.asarray(getattr(st, k)), shift,
+                          axis=0)[:fill].copy()
+               for k in Transition._fields}
+        if self.channels_last:
+            out = snapshot_states_to_nchw(out)
+        return out
 
     def restore(self, data: dict) -> int:
         """Refill via the normal chunked write path (works across capacity
@@ -242,7 +282,8 @@ class DeviceReplayIngest:
     def __init__(self, capacity: int, state_shape: Tuple[int, ...],
                  action_shape: Tuple[int, ...] = (),
                  state_dtype=np.uint8, action_dtype=np.int32,
-                 chunk_size: int = 64, max_queue_chunks: int = 4096):
+                 chunk_size: int = 64, max_queue_chunks: int = 4096,
+                 channels_last: bool = False):
         import multiprocessing as mp
 
         self.capacity = capacity
@@ -251,6 +292,7 @@ class DeviceReplayIngest:
         self.state_dtype = np.dtype(state_dtype)
         self.action_dtype = np.dtype(action_dtype)
         self.chunk_size = chunk_size
+        self.channels_last = channels_last
         # Ingest sizes, largest-first: a deep backlog moves in few large
         # transfers (one jit trace per size) instead of many chunk_size
         # ones — host->device transfer count, not bytes, is what stalls a
@@ -279,7 +321,8 @@ class DeviceReplayIngest:
         capacity = round_capacity(self.capacity, mesh)
         self.replay = DeviceReplay(
             capacity, self.state_shape, self.action_shape,
-            self.state_dtype, self.action_dtype, mesh=mesh)
+            self.state_dtype, self.action_dtype, mesh=mesh,
+            channels_last=self.channels_last)
         return self.replay
 
     @property
@@ -380,5 +423,5 @@ class DevicePerIngest(DeviceReplayIngest):
             priority_exponent=self.priority_exponent,
             importance_weight=self.importance_weight,
             importance_anneal_steps=self.importance_anneal_steps,
-            mesh=mesh)
+            mesh=mesh, channels_last=self.channels_last)
         return self.replay

@@ -120,3 +120,78 @@ def test_multi_step_dispatch_topology(tmp_path):
 
     tags = {r["tag"] for r in read_scalars(opt.log_dir)}
     assert "learner/critic_loss" in tags
+
+
+def test_channels_last_ring_matches_nchw_training():
+    """NHWC-resident ring + nhwc_input model == NCHW ring + default model:
+    same ingested transitions, same sampling keys -> identical sampled
+    contents and identical train-step losses (the layout is an internal
+    storage detail; factory.device_ring_channels_last wires it)."""
+    from pytorch_distributed_tpu.models import DqnCnnModel
+    from pytorch_distributed_tpu.ops.losses import (
+        build_dqn_train_step, init_train_state, make_optimizer,
+    )
+
+    rng = np.random.default_rng(7)
+    n, shape = 32, (4, 12, 12)
+    chunk = Transition(
+        state0=rng.integers(0, 255, (n, *shape)).astype(np.uint8),
+        action=rng.integers(0, 4, n).astype(np.int32),
+        reward=rng.normal(size=n).astype(np.float32),
+        gamma_n=np.full(n, 0.99, np.float32),
+        state1=rng.integers(0, 255, (n, *shape)).astype(np.uint8),
+        terminal1=(rng.random(n) < 0.2).astype(np.float32),
+    )
+    key = jax.random.PRNGKey(3)
+
+    losses = {}
+    for cl in (False, True):
+        ring = DeviceReplay(capacity=n, state_shape=shape,
+                            state_dtype=np.uint8, channels_last=cl)
+        ring.feed_chunk(chunk)
+        batch = jax.tree_util.tree_map(np.asarray,
+                                       ring.sample(16, key))
+        # same rows drawn regardless of layout...
+        assert batch.state0.shape == ((16, 12, 12, 4) if cl
+                                      else (16, *shape))
+        model = DqnCnnModel(action_space=4, norm_val=255.0,
+                            nhwc_input=cl, compute_dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 12, 12, 4) if cl
+                                     else (1, *shape), np.uint8))
+        tx = make_optimizer(lr=1e-3)
+        state = init_train_state(params, tx)
+        step = jax.jit(build_dqn_train_step(model.apply, tx,
+                                            target_model_update=10))
+        _state, metrics, _td = step(state, ring.sample(16, key))
+        losses[cl] = float(metrics["learner/critic_loss"])
+    # ...and the training math is layout-invariant (params init from the
+    # same seed produce the same tree either way)
+    assert losses[False] == pytest.approx(losses[True], rel=1e-5)
+
+
+def test_channels_last_snapshot_is_nchw():
+    """Checkpoints stay layout-independent: a channels-last ring's
+    snapshot rolls back to the public NCHW schema and restores into a
+    NCHW ring (and vice versa)."""
+    rng = np.random.default_rng(11)
+    n, shape = 8, (4, 6, 6)
+    chunk = Transition(
+        state0=rng.integers(0, 255, (n, *shape)).astype(np.uint8),
+        action=np.zeros(n, np.int32),
+        reward=np.arange(n, dtype=np.float32),
+        gamma_n=np.full(n, 0.99, np.float32),
+        state1=rng.integers(0, 255, (n, *shape)).astype(np.uint8),
+        terminal1=np.zeros(n, np.float32),
+    )
+    a = DeviceReplay(capacity=n, state_shape=shape, state_dtype=np.uint8,
+                     channels_last=True)
+    a.feed_chunk(chunk)
+    snap = a.snapshot()
+    assert snap["state0"].shape == (n, *shape)  # public NCHW schema
+    np.testing.assert_array_equal(snap["state0"], chunk.state0)
+    b = DeviceReplay(capacity=n, state_shape=shape, state_dtype=np.uint8,
+                     channels_last=False)
+    b.restore(snap)
+    np.testing.assert_array_equal(
+        np.asarray(b.state.state0[:n]), chunk.state0)
