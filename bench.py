@@ -291,7 +291,7 @@ def bench_families() -> dict:
     from pytorch_distributed_tpu.config import build_options
     from pytorch_distributed_tpu.factory import (
         build_model, build_train_state_and_step, init_params, lstm_dim_of,
-        probe_env,
+        probe_env, sequence_pack_frames,
     )
     from pytorch_distributed_tpu.memory.sequence_replay import SegmentBatch
     from pytorch_distributed_tpu.utils.experience import Batch
@@ -317,9 +317,14 @@ def bench_families() -> dict:
             weight=np.ones(B, np.float32),
             index=np.arange(B, dtype=np.int32))
 
-    def seq_batch(spec, B, L, hidden):
+    def seq_batch(spec, B, L, hidden, pack=0):
         S = spec.state_shape
-        if len(S) == 3:
+        if pack:
+            # frame-packed wire format (sequence_pack_frames): the
+            # de-duplicated frame sequence the pixel R2D2 learner ships
+            obs = rng.integers(0, 255,
+                               size=(B, L + pack, *S[1:])).astype(np.uint8)
+        elif len(S) == 3:
             obs = rng.integers(0, 255, size=(B, L + 1, *S)).astype(np.uint8)
         else:
             obs = rng.normal(size=(B, L + 1, *S)).astype(np.float32)
@@ -361,7 +366,8 @@ def bench_families() -> dict:
             # stored-state width must match what the factory's replay
             # stores (the CNN variant floors at its torso width)
             batch = seq_batch(spec, B, opt.agent_params.seq_len,
-                              lstm_dim_of(opt))
+                              lstm_dim_of(opt),
+                              pack=sequence_pack_frames(opt))
         else:
             batch = flat_batch(spec, B)
         batch = jax.device_put(batch)  # pre-staged: measures the program

@@ -21,6 +21,7 @@ import numpy as np
 from pytorch_distributed_tpu.config import Options
 from pytorch_distributed_tpu.factory import (
     EnvSpec, build_env_vector, build_model, init_params,
+    sequence_pack_frames,
 )
 from pytorch_distributed_tpu.agents.actor import _ActorHarness
 from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
@@ -43,7 +44,8 @@ class _RecurrentHarness(_ActorHarness):
                        else np.float32)
         self.builders = [
             SegmentBuilder(ap.seq_len, ap.seq_overlap,
-                           state_dtype=state_dtype)
+                           state_dtype=state_dtype,
+                           pack_frames=sequence_pack_frames(opt))
             for _ in range(self.num_envs)]
         # one batched carry; per-env rows reset at episode ends.  The
         # initial-carry rows are precomputed host-side once so per-episode

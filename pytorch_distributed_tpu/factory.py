@@ -216,6 +216,22 @@ def probe_env(opt: Options) -> EnvSpec:
 # Model builders
 # ---------------------------------------------------------------------------
 
+def sequence_pack_frames(opt: Options) -> int:
+    """Frame-pack factor C for sequence replay (0 = unpacked).
+
+    C-stacked uint8 image segments ship every pixel C times; packing
+    stores the de-duplicated frame sequence and the learner rebuilds
+    stacks on device (memory/sequence_replay.py SegmentBuilder /
+    ops/sequence_losses.py unpack_frame_stacks).  Decided HERE so the
+    three parties — actor-side builders, the replay allocation, and the
+    learner step — can never disagree on the wire format.  Only the
+    pixel R2D2 family qualifies (the dtqn rows are low-dim)."""
+    if (opt.memory_type == "sequence" and opt.model_type == "drqn-cnn"
+            and opt.memory_params.state_dtype == "uint8"):
+        return opt.env_params.state_cha
+    return 0
+
+
 def lstm_dim_of(opt: Options) -> int:
     """Stored-recurrent-state width for the configured model (the CNN
     variant floors at 512, matching its torso output; transformers store
@@ -438,7 +454,9 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
                     p, obs, method=train_model.window_q)
             step = build_dtqn_train_step(window_apply, tx, **kw)
         else:
-            step = build_drqn_train_step(model.apply, tx, **kw)
+            step = build_drqn_train_step(
+                model.apply, tx,
+                packed_frames=sequence_pack_frames(opt), **kw)
         return state, step
 
     if opt.agent_type == "dqn":
@@ -597,6 +615,7 @@ def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
             priority_exponent=mp_.priority_exponent,
             importance_weight=mp_.priority_weight,
             importance_anneal_steps=ap.steps * ap.batch_size,
+            pack_frames=sequence_pack_frames(opt),
         )
         owner = QueueOwner(seq)
         return MemoryHandles(actor_side=owner.make_feeder(),

@@ -61,14 +61,27 @@ class SegmentBuilder:
     LSTM carry it held BEFORE acting (the state to store for this step),
     and the step outcome — and returns zero or more finished Segments.
     Episode ends flush a padded+masked tail and reset the stream (overlap
-    never crosses episodes)."""
+    never crosses episodes).
+
+    ``pack_frames=C`` (image obs only) stores segments FRAME-PACKED:
+    consecutive C-stacked observations share C-1 frames, so a stacked
+    segment ships every pixel C times.  Packed, ``obs`` is the
+    de-duplicated frame sequence (T+C, H, W) — stack t is frames
+    [t, t+C) — cutting the actor->learner queue bytes, host RAM, and
+    the per-update host->device transfer ~C-fold; the learner
+    reconstructs stacks on device (ops/sequence_losses.py
+    unpack_frame_stacks).  Motivation: the R2D2 pixel learner measured
+    H2D-bound at ~1 update/s with stacked 16x17-stack batches through
+    the ~50 MB/s tunnel (2026-07-31)."""
 
     def __init__(self, seq_len: int, overlap: int,
-                 state_dtype=np.float32):
+                 state_dtype=np.float32, pack_frames: int = 0):
         assert 0 <= overlap < seq_len, (overlap, seq_len)
         self.T = seq_len
         self.overlap = overlap
         self.state_dtype = np.dtype(state_dtype)
+        self.pack_frames = int(pack_frames)
+        self._checked_sliding = False  # one-time invariant check on emit
         self._steps: List[tuple] = []  # (obs, a, r, term, next_obs, c, h)
 
     def push(self, obs, action, reward, terminal, next_obs,
@@ -100,24 +113,59 @@ class SegmentBuilder:
         T = self.T
         steps = self._steps[:n]
         obs0 = steps[0][0]
-        obs = np.zeros((T + 1, *obs0.shape), dtype=self.state_dtype)
         action = np.zeros(T, np.int32)
         reward = np.zeros(T, np.float32)
         terminal = np.zeros(T, np.float32)
         mask = np.zeros(T, np.float32)
         for t, (o, a, r, term, nxt, _c, _h) in enumerate(steps):
-            obs[t] = o
             action[t] = a
             reward[t] = r
             terminal[t] = float(term)
             mask[t] = 1.0
-        obs[n] = steps[n - 1][4]  # bootstrap observation
-        # pad slots keep the bootstrap obs so scans stay shape-static
-        for t in range(n + 1, T + 1):
-            obs[t] = obs[n]
+        if self.pack_frames:
+            obs = self._emit_packed(steps, n)
+        else:
+            obs = np.zeros((T + 1, *obs0.shape), dtype=self.state_dtype)
+            for t, s in enumerate(steps):
+                obs[t] = s[0]
+            obs[n] = steps[n - 1][4]  # bootstrap observation
+            # pad slots keep the bootstrap obs so scans stay shape-static
+            for t in range(n + 1, T + 1):
+                obs[t] = obs[n]
         return Segment(obs=obs, action=action, reward=reward,
                        terminal=terminal, mask=mask,
                        c0=steps[0][5], h0=steps[0][6])
+
+    def _emit_packed(self, steps, n: int) -> np.ndarray:
+        """De-duplicated frame sequence (T+C, H, W): frames [0, C) are
+        step 0's full stack, frame C-1+t is step t's newest frame, frame
+        C-1+n the bootstrap's newest; pad frames repeat the bootstrap
+        frame (padded positions are mask=0 and the n-step bootstrap index
+        clamps to <= n_valid, so reconstructed pad stacks are never
+        read)."""
+        C, T = self.pack_frames, self.T
+        obs0 = steps[0][0]
+        assert obs0.shape[0] == C, (
+            f"pack_frames={C} but stacked obs has {obs0.shape[0]} channels")
+        if not self._checked_sliding and n >= 2:
+            # Packing is only sound for sliding-window stacks (each push's
+            # stack = previous stack shifted one frame).  A non-sliding
+            # env would pass the shape assert yet reconstruct corrupted
+            # channels — check the invariant once, on the first real
+            # segment, at negligible cost.
+            self._checked_sliding = True
+            assert np.array_equal(steps[1][0][:-1], steps[0][0][1:]), (
+                "pack_frames set but observations are not a sliding "
+                "frame-stack (obs[t][:-1] != obs[t-1][1:]); disable "
+                "packing for this env")
+        frames = np.zeros((T + C, *obs0.shape[1:]), dtype=self.state_dtype)
+        frames[:C] = obs0
+        for t in range(1, n):
+            frames[C - 1 + t] = steps[t][0][-1]
+        frames[C - 1 + n] = steps[n - 1][4][-1]  # bootstrap newest frame
+        for t in range(n + 1, T + 1):
+            frames[C - 1 + t] = frames[C - 1 + n]
+        return frames
 
     def reset(self) -> None:
         self._steps = []
@@ -134,14 +182,22 @@ class SequenceReplay:
                  state_dtype=np.float32,
                  priority_exponent: float = 0.9,
                  importance_weight: float = 0.6,
-                 importance_anneal_steps: int = 500000):
+                 importance_anneal_steps: int = 500000,
+                 pack_frames: int = 0):
         self.capacity = capacity
         self.T = seq_len
         self.alpha = priority_exponent
         self.beta0 = importance_weight
         self.beta_steps = importance_anneal_steps
+        self.pack_frames = int(pack_frames)
         S = tuple(state_shape)
-        self.obs = np.zeros((capacity, seq_len + 1, *S), dtype=state_dtype)
+        if self.pack_frames:
+            # frame-packed rows: (T+C, H, W) — see SegmentBuilder
+            assert S[0] == self.pack_frames, (S, pack_frames)
+            obs_shape = (seq_len + self.pack_frames, *S[1:])
+        else:
+            obs_shape = (seq_len + 1, *S)
+        self.obs = np.zeros((capacity, *obs_shape), dtype=state_dtype)
         self.action = np.zeros((capacity, seq_len), np.int32)
         self.reward = np.zeros((capacity, seq_len), np.float32)
         self.terminal = np.zeros((capacity, seq_len), np.float32)

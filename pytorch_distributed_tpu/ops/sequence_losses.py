@@ -55,6 +55,19 @@ def value_unrescale(x: jnp.ndarray, eps: float = RESCALE_EPS) -> jnp.ndarray:
                     - 1.0) / (2.0 * eps)) - 1.0)
 
 
+def unpack_frame_stacks(frames: jnp.ndarray, C: int,
+                        seq_len: int) -> jnp.ndarray:
+    """Rebuild C-stacked observations from a frame-packed segment
+    (memory/sequence_replay.py SegmentBuilder pack_frames): frames
+    (B, T+C, H, W) -> stacks (B, T+1, C, H, W), stack t = frames
+    [t, t+C) with channel 0 oldest — exactly the env's frame-stack
+    layout.  Runs inside the jitted step: the C-fold de-duplication
+    lives on the wire/host, the redundancy is re-materialised only in
+    device HBM where it is cheap."""
+    return jnp.stack([frames[:, i:i + seq_len + 1] for i in range(C)],
+                     axis=2)
+
+
 def unroll(apply_fn: Callable, params: PyTree, carry,
            obs_tm: jnp.ndarray) -> Tuple[Any, jnp.ndarray]:
     """Scan the single-step recurrent apply over a time-major observation
@@ -154,16 +167,24 @@ def build_drqn_train_step(
     rescale_values: bool = True,
     priority_eta: float = 0.9,
     axis_name: str | None = None,
+    packed_frames: int = 0,
 ) -> Callable[[TrainState, SegmentBatch],
               Tuple[TrainState, Dict[str, jnp.ndarray], jnp.ndarray]]:
-    """Returns ``(state, batch) -> (state, metrics, seq_priorities)``."""
+    """Returns ``(state, batch) -> (state, metrics, seq_priorities)``.
+
+    ``packed_frames=C``: ``batch.obs`` arrives frame-packed (B, T+C, H,
+    W) and the stacks are rebuilt on device (unpack_frame_stacks) — the
+    R2D2 pixel path's host->device transfer shrinks ~C-fold."""
 
     h = value_rescale if rescale_values else (lambda x: x)
     h_inv = value_unrescale if rescale_values else (lambda x: x)
 
     def step(state: TrainState, batch: SegmentBatch):
-        obs_tm = jnp.moveaxis(batch.obs, 0, 1)      # (T+1, B, *S)
         T = batch.action.shape[1]
+        obs = batch.obs
+        if packed_frames:
+            obs = unpack_frame_stacks(obs, packed_frames, T)
+        obs_tm = jnp.moveaxis(obs, 0, 1)            # (T+1, B, *S)
         train_len = T - burn_in
         carry0 = (batch.c0, batch.h0)
 

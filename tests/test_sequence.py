@@ -281,3 +281,137 @@ def test_r2d2_chain_topology_learns(tmp_path):
     out = runtime.test(opt2)
     assert out["avg_reward"] >= 0.9
     assert out["avg_steps"] <= 10
+
+
+class TestFramePacking:
+    """Frame-packed segments (SegmentBuilder pack_frames): the wire/RAM
+    representation drops the C-fold stack redundancy; learner-side
+    reconstruction must be exact."""
+
+    @staticmethod
+    def _stacked_episode(n, C=4, H=6, W=6, seed=0):
+        """Simulate a frame-stack env: per-step new frame, stack = last
+        C frames (oldest first), reset stack = first frame repeated."""
+        rng = np.random.default_rng(seed)
+        frames = [rng.integers(0, 255, (H, W)).astype(np.uint8)
+                  for _ in range(n + 1)]
+        stacks = []
+        for t in range(n + 1):
+            window = [frames[max(0, t - C + 1 + i)] for i in range(C)]
+            stacks.append(np.stack(window))
+        return stacks  # obs[t] for t=0..n (obs[n] = bootstrap)
+
+    @pytest.mark.parametrize("overlap", [0, 4])
+    def test_packed_reconstruction_matches_stacks(self, overlap):
+        # overlap > 0 exercises the retention path: the SECOND emitted
+        # segment starts from retained raw steps, and packing must stay
+        # exact there too
+        import jax
+
+        from pytorch_distributed_tpu.ops.sequence_losses import (
+            unpack_frame_stacks,
+        )
+
+        T, C = 8, 4
+        n_steps = T + (T - overlap)  # enough for two emissions
+        stacks = self._stacked_episode(n_steps, C=C)
+        packed_b = SegmentBuilder(T, overlap, state_dtype=np.uint8,
+                                  pack_frames=C)
+        plain_b = SegmentBuilder(T, overlap, state_dtype=np.uint8)
+        carry = (np.zeros(3, np.float32), np.zeros(3, np.float32))
+        packed, plain = [], []
+        for t in range(n_steps):
+            args = (stacks[t], t % 3, float(t), t == n_steps - 1,
+                    stacks[t + 1], carry)
+            packed += packed_b.push(*args)
+            plain += plain_b.push(*args)
+        assert len(packed) == len(plain) >= 2
+        for p, u in zip(packed, plain):
+            assert p.obs.shape == (T + C, 6, 6)
+            rebuilt = np.asarray(unpack_frame_stacks(
+                jax.numpy.asarray(p.obs[None]), C, T))[0]
+            np.testing.assert_array_equal(rebuilt, u.obs)
+
+    def test_packed_early_termination_pads_consistently(self):
+        import jax
+
+        from pytorch_distributed_tpu.ops.sequence_losses import (
+            unpack_frame_stacks,
+        )
+
+        T, C, n = 8, 4, 3  # episode dies after 3 steps -> padded tail
+        stacks = self._stacked_episode(n, C=C)
+        b = SegmentBuilder(T, 0, state_dtype=np.uint8, pack_frames=C)
+        carry = (np.zeros(2, np.float32), np.zeros(2, np.float32))
+        out = []
+        for t in range(n):
+            out += b.push(stacks[t], 0, 1.0, t == n - 1, stacks[t + 1],
+                          carry)
+        seg = out[0]
+        assert seg.obs.shape == (T + C, 6, 6)
+        rebuilt = np.asarray(unpack_frame_stacks(
+            jax.numpy.asarray(seg.obs[None]), C, T))[0]
+        # valid positions 0..n-1 and the bootstrap position n are exact
+        for t in range(n):
+            np.testing.assert_array_equal(rebuilt[t], stacks[t])
+        np.testing.assert_array_equal(rebuilt[n], stacks[n])
+        # tail is masked: only shape-stability matters there
+        assert float(seg.mask[:n].sum()) == n and float(seg.mask[n:].sum()) == 0
+
+    def test_packed_drqn_step_matches_unpacked(self):
+        """Same transitions, packed vs stacked wire format -> identical
+        loss/priorities from build_drqn_train_step."""
+        import jax
+
+        from pytorch_distributed_tpu.memory.sequence_replay import (
+            SegmentBatch,
+        )
+        from pytorch_distributed_tpu.models.drqn import DrqnCnnModel
+        from pytorch_distributed_tpu.ops.losses import (
+            init_train_state, make_optimizer,
+        )
+        from pytorch_distributed_tpu.ops.sequence_losses import (
+            build_drqn_train_step,
+        )
+
+        T, C = 6, 4
+        # 36x36: the smallest square that survives the Nature conv
+        # stack's VALID 8/4 -> 4/2 -> 3/1 reductions
+        stacks = self._stacked_episode(T, C=C, H=36, W=36, seed=3)
+        pb = SegmentBuilder(T, 0, state_dtype=np.uint8, pack_frames=C)
+        ub = SegmentBuilder(T, 0, state_dtype=np.uint8)
+        lstm = 8
+        carry = (np.zeros(lstm, np.float32), np.zeros(lstm, np.float32))
+        rng = np.random.default_rng(5)
+        segs = {}
+        for name, b in (("p", pb), ("u", ub)):
+            rng2 = np.random.default_rng(5)
+            out = []
+            for t in range(T):
+                out += b.push(stacks[t], int(rng2.integers(3)),
+                              float(rng2.normal()), t == T - 1,
+                              stacks[t + 1], carry)
+            segs[name] = out[0]
+
+        model = DrqnCnnModel(action_space=3, lstm_dim=lstm, norm_val=255.0,
+                             compute_dtype=jax.numpy.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            np.zeros((1, C, 36, 36), np.uint8))
+        tx = make_optimizer(lr=1e-3)
+        losses = {}
+        for name, packed_frames in (("p", C), ("u", 0)):
+            s = segs[name]
+            batch = SegmentBatch(
+                obs=s.obs[None], action=s.action[None],
+                reward=s.reward[None], terminal=s.terminal[None],
+                mask=s.mask[None], c0=s.c0[None], h0=s.h0[None],
+                weight=np.ones(1, np.float32),
+                index=np.zeros(1, np.int32))
+            step = jax.jit(build_drqn_train_step(
+                model.apply, tx, burn_in=2, nstep=3,
+                target_model_update=100, packed_frames=packed_frames))
+            _st, metrics, pr = step(init_train_state(params, tx), batch)
+            losses[name] = (float(metrics["learner/critic_loss"]),
+                            float(pr[0]))
+        assert losses["p"][0] == pytest.approx(losses["u"][0], rel=1e-5)
+        assert losses["p"][1] == pytest.approx(losses["u"][1], rel=1e-5)
