@@ -517,16 +517,29 @@ def main() -> None:
 
     headline = result.get("updates_per_sec")
     n_dev = len(jax.devices())
-    out = {
-        "metric": "dqn_cnn_learner_updates_per_sec"
-                  if headline is not None else "e2e_frames_per_sec",
-        "value": headline if headline is not None
-                 else result.get("e2e_frames_per_sec"),
-        "unit": f"updates/s (batch {MICRO_BATCH}, "
+    if headline is not None:
+        metric = "dqn_cnn_learner_updates_per_sec"
+        value = headline
+        unit = (f"updates/s (batch {MICRO_BATCH}, "
                 f"production fused x{MICRO_DISPATCH}, "
                 f"HBM replay, {n_dev} device(s), "
-                f"{jax.devices()[0].platform})"
-                if headline is not None else "agent steps/s",
+                f"{jax.devices()[0].platform})")
+    elif args.mode in ("e2e", "both"):
+        # e2e ran (value may be None on an error path — keep the e2e
+        # metric label either way so consumers see what failed)
+        metric, value, unit = ("e2e_frames_per_sec",
+                               result.get("e2e_frames_per_sec"),
+                               "agent steps/s")
+    else:  # families-only invocation: summarize the per-family table
+        fams = result.get("families", {})
+        rates = [v["updates_per_sec"] for v in fams.values()]
+        metric = "family_learner_updates_per_sec_median"
+        value = round(float(np.median(rates)), 2) if rates else None
+        unit = f"updates/s (median of {len(rates)} model families)"
+    out = {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
         "vs_baseline": round(headline / BASELINE_UPDATES_PER_SEC, 3)
                        if headline is not None else None,
         "vs_baseline_basis": "self-declared 250 updates/s (consumer-GPU "

@@ -179,6 +179,14 @@ class AgentParams:
     evaluator_freq: int = 30           # secs; ddpg: 60
     evaluator_nepisodes: int = 2
     tester_nepisodes: int = 50
+    # Unix niceness applied to the evaluator process (0 = none).  Its
+    # bursty batch-1 greedy episodes starved the learner on an
+    # oversubscribed host (runtime._child_main); on a 1-core host the
+    # default 5 inverts the problem — the evaluator gets so little CPU
+    # that eval cadence stretches from ~60 s to minutes — so few-core
+    # runs that care about fine-grained eval curves should lower it
+    # (--set evaluator_nice=0).
+    evaluator_nice: int = 5
     # --- TPU-native publication/checkpoint cadence (no reference
     # equivalent: there weight visibility is implicit shared-CUDA and only
     # the evaluator checkpoints) ---

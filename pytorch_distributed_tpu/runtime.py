@@ -63,6 +63,20 @@ def _child_main(role: str, agent_type: str, args: tuple) -> None:
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_compilation_cache_dir", None)
+    if role == "evaluator":
+        # The evaluator's batch-1 greedy episodes are bursty CPU work
+        # that matters only for reporting cadence; on an oversubscribed
+        # host its bursts starved the learner (observed: the config-14
+        # learner fell 2.2 -> 0.1 updates/s once eval episodes
+        # lengthened, 2026-07-31).  Deprioritise it so the training
+        # plane keeps the core — tunable because the flip side is a
+        # starved evaluator on a 1-core host (AgentParams.evaluator_nice).
+        nice = args[0].agent_params.evaluator_nice
+        if nice:
+            try:
+                os.nice(nice)
+            except OSError:  # pragma: no cover - restricted environments
+                pass
     get_worker(role, agent_type)(*args)
 
 
