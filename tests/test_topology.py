@@ -59,8 +59,10 @@ def test_dqn_chain_topology_trains_and_checkpoints(tmp_path):
     assert "actor/avg_reward" in tags
     assert "evaluator/avg_reward" in tags
 
-    # evaluator wrote the params-only checkpoint; learner the full state
+    # evaluator wrote the params-only checkpoint (+ the best-so-far
+    # tier); learner the full state
     assert os.path.exists(opt.model_name + ".msgpack")
+    assert os.path.exists(opt.model_name + "_best.msgpack")
     assert os.path.isdir(opt.model_name + "_state")
 
     # mode-2 tester loads the checkpoint and runs greedy episodes
