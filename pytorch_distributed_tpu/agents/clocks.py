@@ -98,25 +98,33 @@ class EvaluatorStats:
         self._lock = _CTX.Lock()
         self.flag = _CTX.Value("b", 0, lock=False)
         self.at_step = _CTX.Value("l", 0, lock=False)
+        # capture wall time: the evaluator attributes each result to the
+        # moment the weights were SNAPSHOTTED, not when the (possibly
+        # CPU-starved) episodes finished — curve timestamps stay exact
+        # under evaluator_nice (agents/evaluator.py docstring)
+        self.at_wall = _CTX.Value("d", 0.0, lock=False)
         # raised when the evaluator exits (after its final eval+checkpoint)
         # so the logger drains everything before closing the run
         self.done = _CTX.Value("b", 0, lock=False)
         for f in self.FIELDS:
             setattr(self, f, _CTX.Value("d", 0.0, lock=False))
 
-    def publish(self, learner_step: int, **kv: float) -> None:
+    def publish(self, learner_step: int, wall: float = 0.0,
+                **kv: float) -> None:
         with self._lock:
             for k, v in kv.items():
                 getattr(self, k).value = v
             self.at_step.value = learner_step
+            self.at_wall.value = wall
             self.flag.value = 1
 
     def consume(self):
-        """Returns (learner_step, stats dict) or None if nothing new."""
+        """Returns (learner_step, wall-or-0, stats dict) or None if
+        nothing new."""
         with self._lock:
             if not self.flag.value:
                 return None
             out = {f: getattr(self, f).value for f in self.FIELDS}
-            step = self.at_step.value
+            step, wall = self.at_step.value, self.at_wall.value
             self.flag.value = 0
-            return step, out
+            return step, wall, out

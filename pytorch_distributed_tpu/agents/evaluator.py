@@ -1,12 +1,26 @@
 """Evaluator process: periodic greedy evaluation + checkpointing.
 
 Re-design of reference core/single_processes/evaluators.py (shared by both
-agent families, reference utils/factory.py:28-29): wake on a short poll,
-every ``evaluator_freq`` seconds pull the freshest published weights, run
+agent families, reference utils/factory.py:28-29): every
+``evaluator_freq`` seconds pull the freshest published weights, run
 ``evaluator_nepisodes`` greedy episodes in ``env.eval()`` mode, hand the
 stats to the logger through the EvaluatorStats flag handshake (reference
 :90-95), and write the params-only checkpoint — the reference's only
 checkpoint writer (reference :97-100).
+
+CAPTURE is decoupled from EVALUATION (no reference equivalent; the
+reference's single loop is also its cadence).  A background thread
+snapshots (weights, learner_step, wall) on the ``evaluator_freq`` cadence
+— a cheap shared-memory copy that holds its schedule even when this
+process is starved of CPU (``evaluator_nice`` on a 1-core host stretched
+the old eval-inline cadence from ~60 s to ~10 min and made a north-star
+run's +18 crossing timestamp a sampling artifact, RESULTS.md round 3) —
+while the expensive greedy episodes drain the snapshot backlog in order
+and publish each result against its CAPTURE step and wall time.  Under
+sustained starvation the backlog drops its oldest pending snapshots
+(bounded lag), but every published point still carries the step/time the
+policy actually existed, so learning-curve crossings are exact regardless
+of how slowly the episodes themselves got scheduled.
 """
 
 from __future__ import annotations
@@ -107,30 +121,70 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         opt.seed, "evaluator"))
     _, unravel = make_flattener(params0)
 
-    version = 0
-    params = None
     best_reward = float("-inf")
 
-    def evaluate() -> None:
-        nonlocal version, params, best_reward
-        got = param_store.fetch(version)
-        if got is not None:
-            flat, version = got
-            # host-side inference: unravel straight onto the CPU device
-            # (actors do the same; see utils/helpers.py pin_to_cpu)
-            params = unravel_on_cpu(unravel, flat)
-        if params is None:
-            return  # learner hasn't published yet
+    # ---- capture thread: cadence-true weight snapshots -------------------
+    # (flat, learner_step, wall) tuples, oldest first.  MAX_BACKLOG bounds
+    # both memory and staleness: under sustained CPU starvation the oldest
+    # pending snapshots drop, so evaluated points thin to what the host
+    # affords while each keeps its true capture attribution.
+    import threading
+    from collections import deque
+
+    MAX_BACKLOG = 8
+    snapshots: deque = deque()
+    snap_lock = threading.Lock()
+
+    def capture_loop() -> None:
+        version = 0
+        flat = None
+        last_cap = float("-inf")  # capture immediately once weights exist
+        while not clock.done(ap.steps):
+            time.sleep(0.25)
+            if time.monotonic() - last_cap < ap.evaluator_freq:
+                continue
+            got = param_store.fetch(version)
+            if got is not None:
+                flat, version = got
+            if flat is None:
+                continue  # learner hasn't published yet
+            last_cap = time.monotonic()
+            with snap_lock:
+                if len(snapshots) >= MAX_BACKLOG:
+                    snapshots.popleft()
+                # re-capturing an unchanged flat at a new step is still a
+                # new curve point (the policy existed unchanged there)
+                snapshots.append((flat, clock.learner_step.value,
+                                  time.time()))
+
+    cap_thread = threading.Thread(target=capture_loop, name="eval-capture",
+                                  daemon=True)
+    cap_thread.start()
+
+    def evaluate(flat: np.ndarray, at_step: int, at_wall: float) -> None:
+        nonlocal best_reward
+        # host-side inference: unravel straight onto the CPU device
+        # (actors do the same; see utils/helpers.py pin_to_cpu)
+        params = unravel_on_cpu(unravel, flat)
         avg_steps, avg_reward, solved = greedy_episodes(
             opt, spec, model, params, env, ap.evaluator_nepisodes)
+        # the logger's handshake slot holds ONE result; when a drained
+        # backlog produces evals faster than its 0.2 s poll, wait for the
+        # slot instead of overwriting an unconsumed point
+        waited = time.monotonic() + 10.0
+        while stats.flag.value and time.monotonic() < waited \
+                and not clock.stop.is_set():
+            time.sleep(0.05)
         stats.publish(
-            clock.learner_step.value,
+            at_step,
+            wall=at_wall,
             avg_steps=avg_steps,
             avg_reward=avg_reward,
             nepisodes=float(ap.evaluator_nepisodes),
             nepisodes_solved=float(solved),
         )
-        # the params-only checkpoint (reference evaluators.py:97-100)
+        # the params-only checkpoint (reference evaluators.py:97-100);
+        # snapshots evaluate oldest-first, so the last write is newest
         ckpt.save_params(ckpt.params_path(opt.model_name), params)
         # best-so-far tier (no reference equivalent): value curves dip —
         # DQN evals can transiently collapse right after a peak — and the
@@ -142,16 +196,31 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             ckpt.save_params(
                 ckpt.params_path(opt.model_name + "_best"), params)
 
+    def pop_snapshot():
+        with snap_lock:
+            return snapshots.popleft() if snapshots else None
+
     try:
-        last_eval = 0.0  # evaluate immediately once weights exist
         while not clock.done(ap.steps):
-            time.sleep(0.25)  # reference evaluators.py wakes every 5 s
-            if time.monotonic() - last_eval < ap.evaluator_freq:
+            snap = pop_snapshot()
+            if snap is None:
+                time.sleep(0.1)
                 continue
-            last_eval = time.monotonic()
-            evaluate()
-        # final eval of the finished weights (short runs may never have hit
-        # the cadence; the run's acceptance signal must still be written)
-        evaluate()
+            evaluate(*snap)
+        # final eval of the FINISHED weights (short runs may never have hit
+        # the cadence; the run's acceptance signal must still be written):
+        # always fetch fresh — a pending backlog snapshot can be up to
+        # evaluator_freq stale, and the final <refs>.msgpack is what
+        # mode-2/resume loads.  Backlog only as a fallback when the fetch
+        # has nothing (learner died before its final publication).
+        cap_thread.join(timeout=2.0)
+        got = param_store.fetch(0)
+        if got is not None:
+            snap = (got[0], clock.learner_step.value, time.time())
+        else:
+            with snap_lock:
+                snap = snapshots.pop() if snapshots else None
+        if snap is not None:
+            evaluate(*snap)
     finally:
         stats.done.value = 1

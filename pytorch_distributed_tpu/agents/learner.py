@@ -41,6 +41,9 @@ from pytorch_distributed_tpu.agents.param_store import (
 from pytorch_distributed_tpu.memory.device_replay import (
     DevicePerIngest, DeviceReplayIngest,
 )
+from pytorch_distributed_tpu.memory.device_sequence import (
+    DeviceSequenceIngest,
+)
 from pytorch_distributed_tpu.memory.feeder import QueueOwner
 from pytorch_distributed_tpu.utils import checkpoint as ckpt
 from pytorch_distributed_tpu.utils.metrics import MetricsWriter
@@ -189,7 +192,11 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         _publish_async = _publish
 
     is_per = isinstance(memory, QueueOwner)
-    is_device_per = isinstance(memory, DevicePerIngest)
+    # the HBM segment ring presents the same fused-priority surface as the
+    # HBM PER ring (attach / build_fused_step / beta / drain), so the
+    # learner drives both through one path (memory/device_sequence.py)
+    is_device_per = isinstance(memory, (DevicePerIngest,
+                                        DeviceSequenceIngest))
     is_device = isinstance(memory, DeviceReplayIngest) and not is_device_per
     on_device = is_device or is_device_per
     if on_device:

@@ -48,13 +48,16 @@ def run_logger(opt: Options, clock: GlobalClock, actor_stats: ActorStats,
 
             got = evaluator_stats.consume()
             if got is not None:
-                at_step, ev = got  # reference dqn_logger.py:23-33
+                # reference dqn_logger.py:23-33; rows carry the CAPTURE
+                # wall time so curve crossings date the policy, not the
+                # (possibly starved) eval episodes
+                at_step, at_wall, ev = got
                 writer.scalars({
                     "evaluator/avg_steps": ev["avg_steps"],
                     "evaluator/avg_reward": ev["avg_reward"],
                     "evaluator/nepisodes": ev["nepisodes"],
                     "evaluator/nepisodes_solved": ev["nepisodes_solved"],
-                }, step=at_step)
+                }, step=at_step, wall=at_wall or None)
 
             def write_group(a: dict, le: dict) -> None:
                 step = clock.learner_step.value

@@ -50,7 +50,7 @@ CONFIGS = [
     ["dqn",       "atari",     "breakout",    "device",      "dqn-cnn"], # 11 Atari-57 sweep row (needs ALE)
     ["dqn",       "pong-sim",  "pong",        "device-per",  "dqn-cnn"], # 12 HBM PER, fully fused
     ["r2d2",      "fake",      "chain",       "sequence",    "drqn-mlp"],# 13 recurrent smoke
-    ["r2d2",      "pong-sim",  "pong",        "sequence",    "drqn-cnn"],# 14 R2D2 pixels
+    ["r2d2",      "pong-sim",  "pong",        "device-sequence", "drqn-cnn"],# 14 R2D2 pixels, HBM segment ring
     ["r2d2",      "fake",      "chain",       "sequence",    "dtqn-mlp"],# 15 transformer Q (DTQN)
     ["ddpg",      "classic",   "reacher",     "shared",      "ddpg-mlp"],# 16 multi-dim continuous control
     ["r2d2",      "fake",      "chain",       "sequence",    "dtqn-moe"],# 17 MoE transformer Q (expert parallel)
@@ -117,6 +117,13 @@ class MemoryParams:
     # resume leg the reference lacks, SURVEY.md §5).  Off by default:
     # image replays serialize to large files; written once at run end.
     checkpoint_replay: bool = False
+    # NHWC (channels-last) storage for HBM device rings — a per-hardware
+    # A/B knob (--set device_channels_last=true), NOT a tuning default:
+    # measured ~13% SLOWER on the TPU v5 lite (XLA pads the 4-wide minor
+    # channel axis to the 128 vector lanes) but kept live for hardware
+    # where the trade flips (factory.device_ring_channels_last docstring
+    # has the measurement).
+    device_channels_last: bool = False
     # NOTE: device-resident (HBM) replay is selected via
     # ``memory_type="device"`` (CONFIGS row 8), not a flag here: the buffer
     # is sharded across the learner mesh's dp axis and sampled on device
@@ -181,11 +188,13 @@ class AgentParams:
     tester_nepisodes: int = 50
     # Unix niceness applied to the evaluator process (0 = none).  Its
     # bursty batch-1 greedy episodes starved the learner on an
-    # oversubscribed host (runtime._child_main); on a 1-core host the
-    # default 5 inverts the problem — the evaluator gets so little CPU
-    # that eval cadence stretches from ~60 s to minutes — so few-core
-    # runs that care about fine-grained eval curves should lower it
-    # (--set evaluator_nice=0).
+    # oversubscribed host (runtime._child_main).  On a 1-core host a
+    # nice'd evaluator runs its episodes more slowly, which thins how
+    # many curve points land per wall-clock hour — but each point still
+    # carries cadence-true capture attribution (step + wall of the
+    # weight snapshot, agents/evaluator.py), so crossings stay exact;
+    # lower this only when eval DENSITY (not accuracy) matters more
+    # than learner throughput (--set evaluator_nice=0).
     evaluator_nice: int = 5
     # --- TPU-native publication/checkpoint cadence (no reference
     # equivalent: there weight visibility is implicit shared-CUDA and only
@@ -413,7 +422,7 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
             # sequence replay is prioritized by default with the R2D2
             # constants (alpha 0.9 / beta0 0.6); --set overrides still land
             **({"priority_exponent": 0.9, "priority_weight": 0.6}
-               if memory_type == "sequence" else {}),
+               if memory_type in ("sequence", "device-sequence") else {}),
         ),
         model_params=ModelParams(model_type=model_type),
         agent_params=build_agent_params(agent_type),

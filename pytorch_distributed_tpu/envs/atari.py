@@ -132,6 +132,10 @@ class AtariEnv(Env):
             reward += self.ale.act(ale_action)
             if k == n - 2:
                 prev = self._screen()
+            if self.ale.game_over():
+                # stop the action-repeat at terminal — the reference never
+                # acts past game over (reference atari_env.py:101-103)
+                break
         frame = self._screen()
         if prev is not None:
             frame = np.maximum(frame, prev)

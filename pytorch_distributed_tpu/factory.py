@@ -53,6 +53,7 @@ MemoriesDict: Dict[str, Optional[Callable]] = {
     "device": None,                    # HBM-resident ring (device_replay.py)
     "device-per": None,                # HBM prioritized ring (device_per.py)
     "sequence": None,                  # episode segments (sequence_replay.py)
+    "device-sequence": None,           # HBM segment ring (device_sequence.py)
     "none": None,                      # reference factory.py:38
 }
 
@@ -226,7 +227,8 @@ def sequence_pack_frames(opt: Options) -> int:
     three parties — actor-side builders, the replay allocation, and the
     learner step — can never disagree on the wire format.  Only the
     pixel R2D2 family qualifies (the dtqn rows are low-dim)."""
-    if (opt.memory_type == "sequence" and opt.model_type == "drqn-cnn"
+    if (opt.memory_type in ("sequence", "device-sequence")
+            and opt.model_type == "drqn-cnn"
             and opt.memory_params.state_dtype == "uint8"):
         return opt.env_params.state_cha
     return 0
@@ -536,23 +538,23 @@ def device_ring_channels_last(opt: Options) -> bool:
 
     Decided here so build_memory (ring geometry, parent process) and
     build_train_state_and_step (the NHWC train apply, learner process)
-    always agree.  Currently ALWAYS False, from measurement, not
-    oversight: the XLA profile showed ~25% of fused-step device time in
-    layout copies, but an interleaved A/B on the TPU v5 lite (2026-07-31,
+    always agree.  Default OFF from measurement, not oversight: the XLA
+    profile showed ~25% of fused-step device time in layout copies, but
+    an interleaved A/B on the TPU v5 lite (2026-07-31,
     tools/mfu_probe.py machinery) measured the channels-last ring ~13%
     SLOWER (2078 -> 1807 updates/s) — TPU tiled layouts pad the minor
     dimension to the 128 vector lanes, so (..., 84, 4) rows pad the
     4-wide channel axis brutally while the NCHW profile's copies are
-    XLA's own (cheaper) preferred re-tilings.  The mechanism stays
-    (DeviceReplay channels_last + DqnCnnModel nhwc_input, layout-
-    equivalence-tested) for hardware where the trade flips — and this
-    predicate carries ALL the eligibility conditions (fused device ring
-    + the CNN model that owns an nhwc_input switch), so flipping the
-    final ``False`` to a measurement is the whole change: host-replay
-    configs and MLP models can never see the NHWC apply."""
+    XLA's own (cheaper) preferred re-tilings.  The mechanism stays live
+    behind ``--set device_channels_last=true`` (DeviceReplay
+    channels_last + DqnCnnModel nhwc_input, layout-equivalence-tested)
+    so a per-hardware A/B never needs a source edit — and this predicate
+    carries ALL the eligibility conditions (fused device ring + the CNN
+    model that owns an nhwc_input switch), so host-replay configs and
+    MLP models can never see the NHWC apply regardless of the flag."""
     eligible = (opt.memory_type in ("device", "device-per")
                 and opt.model_type == "dqn-cnn")
-    return eligible and False  # False by measurement (see docstring)
+    return eligible and opt.memory_params.device_channels_last
 
 
 def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
@@ -620,6 +622,27 @@ def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
         owner = QueueOwner(seq)
         return MemoryHandles(actor_side=owner.make_feeder(),
                              learner_side=owner)
+    if opt.memory_type == "device-sequence":
+        from pytorch_distributed_tpu.memory.device_sequence import (
+            DeviceSequenceIngest,
+        )
+
+        ap = opt.agent_params
+        ingest = DeviceSequenceIngest(
+            # same segments-per-history-span arithmetic as the host plane
+            capacity=max(mp_.memory_size
+                         // max(ap.seq_len - ap.seq_overlap, 1), 16),
+            seq_len=ap.seq_len,
+            state_shape=spec.state_shape,
+            lstm_dim=lstm_dim_of(opt),
+            state_dtype=state_dtype,
+            priority_exponent=mp_.priority_exponent,
+            importance_weight=mp_.priority_weight,
+            importance_anneal_steps=ap.steps,
+            pack_frames=sequence_pack_frames(opt),
+        )
+        return MemoryHandles(actor_side=ingest.make_feeder(),
+                             learner_side=ingest)
     if opt.memory_type in ("device", "device-per"):
         from pytorch_distributed_tpu.memory.device_replay import (
             DevicePerIngest, DeviceReplayIngest,

@@ -158,6 +158,14 @@ class SegmentBuilder:
                 "pack_frames set but observations are not a sliding "
                 "frame-stack (obs[t][:-1] != obs[t-1][1:]); disable "
                 "packing for this env")
+            # next_obs must slide from obs the same way: the bootstrap
+            # frame is taken from next_obs[-1] (frames[C-1+n] below), so
+            # an env wrapper handing back e.g. the post-reset observation
+            # as next_obs would silently store a wrong bootstrap frame at
+            # truncation-style segment ends (advisor finding, round 3)
+            assert np.array_equal(steps[0][4][:-1], steps[0][0][1:]), (
+                "pack_frames set but next_obs does not slide from obs "
+                "(next_obs[:-1] != obs[1:]); disable packing for this env")
         frames = np.zeros((T + C, *obs0.shape[1:]), dtype=self.state_dtype)
         frames[:C] = obs0
         for t in range(1, n):

@@ -40,10 +40,15 @@ class MetricsWriter:
                "wall": wall if wall is not None else time.time()}
         self._jsonl.write(json.dumps(rec) + "\n")
         if self._tb is not None:
-            self._tb.add_scalar(tag, float(value), int(step))
+            # explicit walltime: TB's wall-clock view must show the same
+            # capture-true timestamps the JSONL rows carry
+            self._tb.add_scalar(tag, float(value), int(step),
+                                walltime=rec["wall"])
 
-    def scalars(self, kv: dict, step: int) -> None:
-        wall = time.time()
+    def scalars(self, kv: dict, step: int,
+                wall: Optional[float] = None) -> None:
+        if wall is None:
+            wall = time.time()
         for tag, value in kv.items():
             self.scalar(tag, value, step, wall)
 

@@ -1,0 +1,77 @@
+"""Evaluator capture/evaluate decoupling (agents/evaluator.py): curve
+points must carry cadence-true capture attribution even when the greedy
+episodes themselves are starved/slow — the round-3 seed-200 north-star
+caveat (evals thinned to ~1/10 min under evaluator_nice, crossing
+timestamp became a sampling artifact) made structural."""
+
+import threading
+import time
+
+import numpy as np
+
+from pytorch_distributed_tpu.config import build_options
+from pytorch_distributed_tpu.factory import probe_env
+from pytorch_distributed_tpu.agents import evaluator as evaluator_mod
+from pytorch_distributed_tpu.agents.clocks import EvaluatorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+from pytorch_distributed_tpu.runtime import _count_params
+
+
+def test_capture_cadence_survives_slow_evals(tmp_path, monkeypatch):
+    FREQ, EVAL_SECS = 0.3, 0.9
+    opt = build_options(1, root_dir=str(tmp_path), evaluator_freq=FREQ,
+                        evaluator_nepisodes=1, steps=10 ** 9)
+    spec = probe_env(opt)
+    clock = GlobalClock()
+    stats = EvaluatorStats()
+    store = ParamStore(_count_params(opt, spec))
+    store.publish(np.zeros(store.num_params, np.float32))
+    clock.set_learner_step(7)
+
+    # each "eval" takes 3x the capture cadence
+    def slow_episodes(opt_, spec_, model, params, env, nepisodes):
+        time.sleep(EVAL_SECS)
+        return 1.0, 1.0, 1
+
+    monkeypatch.setattr(evaluator_mod, "greedy_episodes", slow_episodes)
+
+    t = threading.Thread(
+        target=evaluator_mod.run_evaluator,
+        args=(opt, spec, 0, None, store, clock, stats), daemon=True)
+    t.start()
+
+    # consume like the logger does, recording capture attribution
+    points = []
+    publish_walls = []
+    deadline = time.monotonic() + 4.0
+    while time.monotonic() < deadline:
+        got = stats.consume()
+        if got is not None:
+            points.append(got)
+            publish_walls.append(time.monotonic())
+        time.sleep(0.02)
+    clock.stop.set()
+    t.join(timeout=15.0)
+    assert not t.is_alive()
+
+    assert len(points) >= 3
+    # capture attribution: wall deltas between consecutive points track the
+    # CAPTURE cadence (FREQ), not the ~EVAL_SECS publish spacing
+    walls = [w for _s, w, _ev in points]
+    assert all(w > 0 for w in walls)
+    cap_deltas = np.diff(walls)
+    pub_deltas = np.diff(publish_walls)
+    assert np.median(cap_deltas) < 0.6 * np.median(pub_deltas), (
+        cap_deltas, pub_deltas)
+    # every point carries the learner step at capture
+    assert all(s == 7 for s, _w, _ev in points)
+
+
+def test_consume_returns_wall_and_resets_flag():
+    stats = EvaluatorStats()
+    stats.publish(42, wall=123.5, avg_steps=1.0, avg_reward=2.0,
+                  nepisodes=1.0, nepisodes_solved=1.0)
+    step, wall, ev = stats.consume()
+    assert (step, wall) == (42, 123.5)
+    assert ev["avg_reward"] == 2.0
+    assert stats.consume() is None

@@ -172,6 +172,25 @@ def test_atari_py_fallback_branch(monkeypatch, no_ale):
     assert (np.diff(col) >= 0).all() and col[-1] > col[0]
 
 
+def test_action_repeat_breaks_at_game_over(monkeypatch, no_ale):
+    """The 4x action-repeat loop must stop acting once the emulator
+    reports game over — the reference breaks mid-repeat (reference
+    atari_env.py:101-103); acting past terminal feeds post-death frames
+    into the final max-pool."""
+    made = []
+    monkeypatch.setitem(sys.modules, "ale_py", _fake_ale_py(made))
+    env = _atari_env()
+    env.eval()  # standard terminals: game_over only
+    env.reset()
+    ale = made[0]
+    # place the emulator two raw frames before its game-over boundary
+    # (FakeALE: a life lost every 40 acts; game over below 2 lives)
+    ale.frames, ale._lives = 78, 2
+    _obs, _r, terminal, _info = env.step(1)
+    assert terminal
+    assert ale.frames == 80  # 2 acts, then break — never 4
+
+
 def test_missing_ale_raises_actionable_error(no_ale):
     with pytest.raises(ImportError, match="pong-sim"):
         _atari_env()
