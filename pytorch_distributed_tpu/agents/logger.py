@@ -38,10 +38,15 @@ def run_logger(opt: Options, clock: GlobalClock, actor_stats: ActorStats,
             if finished and finished_at is None:
                 finished_at = time.monotonic()
             # after the run ends, keep draining until the evaluator's final
-            # eval lands (grace-capped) so its scalars are not dropped
+            # eval lands (grace-capped) so its scalars are not dropped.
+            # Grace sits just under runtime._join_all's 240 s deadline —
+            # a batch-1 pixel eval on a starved 1-core host takes minutes,
+            # and a 60 s grace silently dropped the config-14 run's final
+            # point (round 4) — while leaving headroom for the quiescence
+            # drains + final write below before the join terminates us.
             closing = finished and (
                 evaluator_stats.done.value
-                or time.monotonic() - finished_at > 60.0)
+                or time.monotonic() - finished_at > 230.0)
             if closing and closing_at is None:
                 closing_at = time.monotonic()
             time.sleep(0.2)

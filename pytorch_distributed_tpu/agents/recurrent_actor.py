@@ -88,11 +88,10 @@ class _RecurrentHarness(_ActorHarness):
         self.carry = carry_after
         self._run_cadences()
 
-    def shutdown(self) -> None:
-        self.flush_stats()
-        if hasattr(self.memory, "flush"):
-            self.memory.flush()
-        self._timing_writer.close()
+    # shutdown: the base _ActorHarness.shutdown is used as-is (its
+    # pending-holds loop is a no-op here — segments carry no deferred
+    # priorities) — a copied override once missed the QueueFeeder.close
+    # fix and hung the config-14 probe's join for 240 s.
 
 
 def run_r2d2_actor(opt: Options, spec: EnvSpec, process_ind: int,
