@@ -233,13 +233,23 @@ class _ActorHarness:
             self._acc = dict.fromkeys(ActorStats.FIELDS, 0.0)
 
     def shutdown(self) -> None:
-        for j in range(self.num_envs):  # unresolved holds: max priority
-            for t, _q in self._q_pending[j]:
-                self.memory.feed(t, None)
-            self._q_pending[j] = []
-        self.flush_stats()
-        if hasattr(self.memory, "flush"):
-            self.memory.flush()
+        # Best-effort final drain: over DCN a terminally disconnected
+        # transport raises from these feeds/flushes (parallel/dcn.py
+        # DcnDisconnected), and a teardown crash here would mask WHY the
+        # loop ended — the runner's exit code must come from the
+        # stop-vs-disconnected split (fleet._remote_actor_main), not
+        # from a flush traceback.  Local queue transports never raise
+        # these, so nothing is hidden on the single-host path.
+        try:
+            for j in range(self.num_envs):  # unresolved holds: max priority
+                for t, _q in self._q_pending[j]:
+                    self.memory.feed(t, None)
+                self._q_pending[j] = []
+            self.flush_stats()
+            if hasattr(self.memory, "flush"):
+                self.memory.flush()
+        except (ConnectionError, OSError):
+            pass
         from pytorch_distributed_tpu.memory.feeder import QueueFeeder
 
         if isinstance(self.memory, QueueFeeder):

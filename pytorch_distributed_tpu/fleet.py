@@ -28,6 +28,33 @@ For TPU pods where multiple hosts each own chips (v4-32+), set
 ``parallel_params.multihost`` so the learner program itself spans hosts via
 ``jax.distributed`` (parallel/mesh.py init_multihost); the fleet layer here
 is about scaling the *actor* side and is orthogonal.
+
+Failure model (details in parallel/dcn.py; drills in tests/test_chaos.py,
+randomized soak in tools/chaos_soak.py):
+
+- **Survives**: a gateway/learner-host blip or restart (actors redial
+  with backoff, re-claim their slots via incarnation fencing, and resend
+  their one unacked experience chunk — at-least-once delivery); an actor
+  crash (its slot frees on disconnect, the replacement re-claims it,
+  paid from the slot's RestartBudget); a partition that heals within
+  ``DCN_RECONNECT_TIMEOUT``; a half-open predecessor connection left by
+  any of the above (fenced off by the reconnector's higher incarnation).
+- **Lost**: stats ticks in flight when a session dies (bounded by the
+  flush cadence; actor-step counts are re-queued client-side), plus the
+  possibility of a duplicated chunk when an EXP ack was lost.  Tick
+  retransmits are seq-deduplicated at the gateway, so step counts and
+  stats do not double-count across blips (residual window: an ack lost
+  across a gateway restart, which forgets the dedup map).
+- **Terminal**: a partition outliving the reconnect budget, or a slot
+  genuinely held by a live duplicate — the actor exits
+  ``EXIT_DISCONNECTED`` (never a fake "run complete"), the supervisor
+  here spends its RestartBudget, and a slot out of budget fails the host
+  fast with a nonzero exit for the outer orchestrator.
+
+Fault injection for drills rides env vars (``DCN_FAULTS_CLIENT`` /
+``DCN_FAULTS_GATEWAY``, spawn children inherit them) or the
+``--faults-client`` / ``--faults-gateway`` CLI knobs below; see
+utils/faults.py for the spec grammar.
 """
 
 from __future__ import annotations
@@ -53,15 +80,21 @@ class FleetTopology(Topology):
                  spec=None):
         super().__init__(opt, spec=spec)
         self.local_actors = min(local_actors, opt.num_actors)
+        self.gateway = self._make_gateway(port)
+        self.port = self.gateway.port
+
+    def _make_gateway(self, port: int):
+        """Single construction point, shared with restart_gateway — a
+        post-restart gateway must be configured identically to the
+        original or recovery behaviour silently diverges mid-run."""
         from pytorch_distributed_tpu.parallel.dcn import (
             DcnGateway, feed_queue_of,
         )
 
-        self.gateway = DcnGateway(
+        return DcnGateway(
             self.param_store, self.clock, self.actor_stats,
             put_chunk=feed_queue_of(self.handles), port=port,
             local_actors=self.local_actors)
-        self.port = self.gateway.port
 
     def _worker_specs(self):
         # local actor slots are [0, local_actors); remote hosts take the
@@ -74,6 +107,16 @@ class FleetTopology(Topology):
         # stop accepting/serving before the learner-side queue closes:
         # an in-flight EXP put on a closed queue would kill a serve thread
         self.gateway.close()
+
+    def restart_gateway(self) -> None:
+        """Tear the gateway down and rebind on the same port — the
+        recovery drill for a learner-host network blip (and the chaos
+        harness's kill-gateway lever).  Remote actors ride through it:
+        their clients redial, re-HELLO with bumped incarnations, and
+        resend their unacked chunks (parallel/dcn.py failure model)."""
+        port = self.gateway.port
+        self.gateway.close()
+        self.gateway = self._make_gateway(port)
 
     def run(self, backend: str = "process") -> None:
         try:
@@ -98,7 +141,15 @@ def run_fleet_learner(opt: Options, local_actors: int = 0, port: int = 5555,
 def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
                        ) -> None:
     """One remote rollout worker: DCN adapters in place of the shared-memory
-    plane, then the standard actor loop (agents/actor.py) unmodified."""
+    plane, then the standard actor loop (agents/actor.py) unmodified.
+
+    Exit code reflects WHAT ended the loop (utils/supervision.py codes):
+    the learner's stop flag exits 0 (run complete — the supervisor frees
+    the slot for good), a terminal session loss exits EXIT_DISCONNECTED
+    (the supervisor respawns the slot from its RestartBudget).  Before
+    the stop/disconnected split, a gateway blip read as "run complete"
+    and silently drained the whole remote fleet with zero restarts
+    consumed."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
@@ -106,11 +157,23 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
 
     from pytorch_distributed_tpu.factory import get_worker, probe_env
     from pytorch_distributed_tpu.parallel.dcn import (
-        DcnClient, RemoteClock, RemoteMemory, RemoteParamStore, RemoteStats,
+        DcnClient, DcnRefused, RemoteClock, RemoteMemory, RemoteParamStore,
+        RemoteStats,
     )
+    from pytorch_distributed_tpu.utils.supervision import EXIT_DISCONNECTED
 
     host, port = coordinator.rsplit(":", 1)
-    client = DcnClient((host, int(port)), process_ind=process_ind)
+    try:
+        client = DcnClient((host, int(port)), process_ind=process_ind)
+    except (ConnectionError, OSError, DcnRefused) as e:
+        # no session was ever established (gateway unreachable, or the
+        # HELLO was refused — slot conflict): still a network/learner-host
+        # condition, not an actor-code crash, so classify it the same
+        # way; anything else (an InjectedCrash drill, a setup bug)
+        # propagates as the crash it is
+        print(f"[fleet] actor-{process_ind} could not establish its DCN "
+              f"session ({e}); exiting {EXIT_DISCONNECTED}")
+        sys.exit(EXIT_DISCONNECTED)
     memory = RemoteMemory(client)
     clock = RemoteClock(client)
     try:
@@ -118,6 +181,14 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
         get_worker("actor", opt.agent_type)(
             opt, spec, process_ind, memory, RemoteParamStore(client), clock,
             RemoteStats(client))
+    except (ConnectionError, OSError):
+        # a terminal DcnDisconnected escapes the actor loop through its
+        # highest-frequency RPC (send_chunk) — swallow it iff the client
+        # latched the loss, so the exit-code split below classifies it
+        # as EXIT_DISCONNECTED, not an anonymous crash; anything else is
+        # a genuine transport bug and must crash loudly
+        if not client.disconnected.is_set():
+            raise
     finally:
         try:
             memory.flush()
@@ -125,6 +196,10 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
         except (ConnectionError, OSError):
             pass
         client.close()
+    if client.disconnected.is_set() and not client.stop.is_set():
+        print(f"[fleet] actor-{process_ind} lost its DCN session; "
+              f"exiting {EXIT_DISCONNECTED} for the supervisor")
+        sys.exit(EXIT_DISCONNECTED)
 
 
 def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
@@ -150,6 +225,8 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
 
     prebuild_native(opt)  # once, before N workers race the same g++
 
+    thread_exits: dict = {}  # slot -> nonzero exit (thread backend only)
+
     def spawn(ind: int):
         if backend == "process":
             w = _CTX.Process(target=_remote_actor_main,
@@ -158,8 +235,25 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
         else:
             import threading
 
-            w = threading.Thread(target=_remote_actor_main,
-                                 args=(opt, coordinator, ind),
+            def _thread_main(ind=ind):
+                from pytorch_distributed_tpu.utils.supervision import (
+                    EXIT_CRASH,
+                )
+
+                try:
+                    _remote_actor_main(opt, coordinator, ind)
+                except SystemExit as e:
+                    # threading machinery swallows SystemExit, which
+                    # would let a session-loss exit read as a clean run
+                    # — record it so the join loop can fail loudly
+                    thread_exits[ind] = int(e.code or 0)
+                except BaseException:
+                    # a genuine crash (incl. an InjectedCrash drill) must
+                    # not vanish into a dead thread's stderr either
+                    thread_exits[ind] = EXIT_CRASH
+                    raise
+
+            w = threading.Thread(target=_thread_main,
                                  name=f"fleet-actor-{ind}", daemon=True)
         w.start()
         return w
@@ -172,9 +266,16 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
     if backend != "process":
         for w in workers.values():
             w.join()
+        bad = {ind: code for ind, code in thread_exits.items() if code}
+        if bad:
+            raise RuntimeError(
+                f"actor host FAILED (thread backend): worker exit codes "
+                f"{bad} — see utils/supervision.describe_exit")
         return []
 
-    from pytorch_distributed_tpu.utils.supervision import RestartBudget
+    from pytorch_distributed_tpu.utils.supervision import (
+        RestartBudget, describe_exit,
+    )
 
     budget = RestartBudget(max_restarts=max_restarts, backoff=True)
     for ind in workers:
@@ -197,7 +298,8 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
                 continue
             delay = budget.request_restart(ind)
             if delay is not None:
-                print(f"[fleet] actor-{ind} died (exit {w.exitcode}); "
+                print(f"[fleet] actor-{ind} died "
+                      f"({describe_exit(w.exitcode)}); "
                       f"restart {budget.count(ind)}/{max_restarts} "
                       f"in {delay:.0f}s")
                 del workers[ind]
@@ -251,7 +353,30 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="Options override, e.g. --set steps=2000 "
                          "--set batch_size=32 (repeatable; int/float/str "
                          "auto-typed). Must match on every host.")
+    ap.add_argument("--faults-client", type=str, default=None,
+                    metavar="SPEC",
+                    help="fault-injection spec for DCN clients on this "
+                         "host (utils/faults.py grammar, e.g. "
+                         "'sever@40,corrupt@90' or 'random:7'); exported "
+                         "as DCN_FAULTS_CLIENT so spawn children inherit")
+    ap.add_argument("--faults-gateway", type=str, default=None,
+                    metavar="SPEC",
+                    help="[learner] fault-injection spec for the gateway "
+                         "(DCN_FAULTS_GATEWAY)")
+    ap.add_argument("--reconnect-timeout", type=float, default=None,
+                    help="seconds a disconnected actor redials before "
+                         "declaring its session lost (DCN_RECONNECT_TIMEOUT)")
+    ap.add_argument("--heartbeat", type=float, default=None,
+                    help="idle seconds between client heartbeat pings "
+                         "(DCN_HEARTBEAT_INTERVAL; <=0 disables)")
     args = ap.parse_args(argv)
+
+    for env, val in (("DCN_FAULTS_CLIENT", args.faults_client),
+                     ("DCN_FAULTS_GATEWAY", args.faults_gateway),
+                     ("DCN_RECONNECT_TIMEOUT", args.reconnect_timeout),
+                     ("DCN_HEARTBEAT_INTERVAL", args.heartbeat)):
+        if val is not None:
+            os.environ[env] = str(val)
 
     from pytorch_distributed_tpu.config import parse_set_overrides
 

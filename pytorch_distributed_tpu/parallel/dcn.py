@@ -27,6 +27,42 @@ payload — JSON for control frames, ``np.savez`` for experience chunks, raw
 fp32 for parameter snapshots.  No pickle on the wire: frames are
 schema-checked, so a gateway never executes peer-controlled code.
 
+Failure model (the session layer; drills in tests/test_chaos.py and
+tools/chaos_soak.py, policy knobs via ``DCN_*`` env vars):
+
+- **Transient disconnects are transparent.**  A send/recv error inside
+  ``DcnClient._request`` redials with exponential backoff, re-HELLOs with
+  a bumped **incarnation number**, and retransmits the one unacknowledged
+  frame — experience delivery is at-least-once (a chunk whose ack was
+  lost may be fed twice; replay sampling tolerates duplicates, lost
+  chunks it cannot).  Retransmitted T_TICKs, whose double-count would
+  skew the fleet step count and stats, are deduplicated gateway-side by
+  sequence number (the one residual window: an ack lost across a
+  gateway RESTART, which forgets the dedup map).  A reconnect that exhausts its budget
+  (``DCN_RECONNECT_TIMEOUT``) is terminal: the client raises
+  ``DcnDisconnected`` and latches ``disconnected`` so the worker exits
+  **nonzero** and the supervision layer (utils/supervision.RestartBudget)
+  engages — never a silent "run complete".
+- **Slot fencing.**  The gateway keys each actor slot by incarnation; a
+  HELLO carrying a higher incarnation for an already-held slot evicts the
+  stale predecessor connection (the half-open leftover of a partition)
+  instead of bouncing off "slot already connected".  Equal/lower
+  incarnations are refused — that is a genuine duplicate actor, the
+  config error that silently skews the fleet-wide Ape-X epsilon schedule.
+- **Liveness vs backpressure.**  The client pings (T_PING) after
+  ``DCN_HEARTBEAT_INTERVAL`` of idleness and bounds every reply wait with
+  ``DCN_REPLY_DEADLINE``; the gateway drops connections idle longer than
+  ``DCN_IDLE_DEADLINE`` (> the ping interval), freeing their slots.  The
+  deadlines are deliberately long relative to ingest stalls: legitimate
+  backpressure (learner compile, full spawn queue) stalls the actor —
+  that is flow control — while a frozen or partitioned peer trips the
+  deadline and enters the reconnect path instead of hanging forever on
+  the old ``settimeout(None)`` socket.
+- **"Learner said stop" and "connection lost" are distinct states**:
+  ``DcnClient.stop`` is set only by a T_CLOCK reply carrying
+  ``stop: true``; ``DcnClient.disconnected`` only by a terminal session
+  loss.  fleet.py maps them to exit codes 0 / EXIT_DISCONNECTED.
+
 Client-side adapters (``RemoteMemory``, ``RemoteParamStore``,
 ``RemoteClock``, ``RemoteStats``) present the exact surfaces the actor
 harness binds to (agents/actor.py), so ``run_dqn_actor``/``run_ddpg_actor``
@@ -37,17 +73,19 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import socket
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
 from pytorch_distributed_tpu.agents.param_store import ParamStore
 from pytorch_distributed_tpu.memory.feeder import QueueFeeder
 from pytorch_distributed_tpu.utils.experience import Transition
+from pytorch_distributed_tpu.utils.faults import FaultInjector
 
 # ---------------------------------------------------------------------------
 # framing
@@ -55,15 +93,23 @@ from pytorch_distributed_tpu.utils.experience import Transition
 
 _HDR = struct.Struct("!BQ")
 
-T_HELLO = 1    # JSON {role, process_ind}            -> T_CLOCK
+T_HELLO = 1    # JSON {role, process_ind, incarnation} -> T_CLOCK
 T_EXP = 2      # savez transition chunk              -> T_CLOCK
 T_GETP = 3     # !Q min_version                      -> T_PARAMS
 T_PARAMS = 4   # !Q version + raw fp32 (empty = no newer snapshot)
 T_CLOCK = 5    # JSON {learner_step, stop}
-T_TICK = 6     # JSON {actor_steps, stats?}          -> T_CLOCK
+T_TICK = 6     # JSON {actor_steps, stats?, seq?}    -> T_CLOCK
 T_BYE = 7      # empty                               -> (close)
+T_PING = 8     # empty heartbeat                     -> T_CLOCK
 
 _MAX_FRAME = 1 << 31  # 2 GiB — far above any chunk; rejects garbage lengths
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
 
 
 def _send_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
@@ -130,26 +176,42 @@ class DcnGateway:
     ``put_chunk`` receives decoded ``[(Transition, priority), ...]`` lists —
     wire it to the single-owner memory's spawn queue (``feed_queue_of``) so
     remote experience merges with local feeders on the learner's drain path.
+
+    Slot registry: each remote actor slot maps to the (incarnation,
+    connection) that owns it.  A reconnecting actor fences its own stale
+    predecessor by arriving with a higher incarnation (see module
+    docstring); connections idle past ``idle_deadline`` seconds — a
+    multiple of the clients' heartbeat interval — are presumed dead and
+    dropped, which frees their slots without waiting on TCP keepalive.
     """
 
     def __init__(self, param_store, clock, actor_stats,
                  put_chunk: Callable[[list], None],
                  host: str = "0.0.0.0", port: int = 0,
-                 local_actors: int = 0):
+                 local_actors: int = 0,
+                 idle_deadline: Optional[float] = None,
+                 faults: Optional[FaultInjector] = None):
         self.param_store = param_store
         self.clock = clock
         self.actor_stats = actor_stats
         self.put_chunk = put_chunk
         self.local_actors = local_actors
+        self._idle_deadline = (_env_float("DCN_IDLE_DEADLINE", 60.0)
+                               if idle_deadline is None else idle_deadline)
+        self._faults = (faults if faults is not None
+                        else FaultInjector.from_env("gateway"))
         self._srv = socket.create_server((host, port))
         self._srv.settimeout(0.25)
         self.port = self._srv.getsockname()[1]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        self._active_slots: set = set()
+        self._slots: Dict[int, Tuple[int, socket.socket]] = {}
+        self._tick_seq: Dict[int, int] = {}  # per-slot dedup high-water
         self._slots_lock = threading.Lock()
+        self._conns: Set[socket.socket] = set()
         self.connections = 0
         self.chunks_in = 0
+        self.fenced = 0  # stale predecessors evicted by higher incarnations
         # all state above must exist before the first connection lands
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="dcn-accept", daemon=True)
@@ -167,6 +229,8 @@ class DcnGateway:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self.connections += 1
+            with self._slots_lock:
+                self._conns.add(conn)
             t = threading.Thread(target=self._serve, args=(conn, addr),
                                  name=f"dcn-conn-{addr}", daemon=True)
             t.start()
@@ -180,33 +244,111 @@ class DcnGateway:
             "stop": bool(self.clock.stop.is_set()),
         }).encode()
 
-    def _claim_slot(self, ind: Optional[int]) -> Optional[str]:
-        """Register a remote actor's global slot; returns an error string on
-        a conflict (slot owned by the learner host's local actors or already
-        held by a live connection — duplicate slots silently skew the
-        fleet-wide Ape-X epsilon schedule)."""
+    @property
+    def active_slots(self) -> Dict[int, int]:
+        """Snapshot of {slot: incarnation} for supervision/chaos asserts."""
+        with self._slots_lock:
+            return {s: inc for s, (inc, _c) in self._slots.items()}
+
+    def _claim_slot(self, ind: Optional[int], incarnation: int,
+                    conn: socket.socket) -> Optional[str]:
+        """Register a remote actor's global slot; returns an error string
+        on a conflict.  A slot held by a LOWER incarnation is not a
+        conflict — it is this actor's own half-open predecessor (a
+        partition or mid-RPC gateway blip left it behind), so the old
+        connection is fenced off and the slot re-keyed; without this a
+        reconnecting actor crash-loops against its own ghost until the
+        RestartBudget drains (utils/supervision.py docstring).  Equal or
+        lower incarnations refuse: duplicate live actors silently skew
+        the fleet-wide Ape-X epsilon schedule.
+
+        Known limit of wall-clock incarnation bases: a MISCONFIGURED
+        genuine duplicate (two hosts claiming overlapping slot ranges)
+        that starts later carries a higher incarnation and evicts the
+        live owner; the evicted side reconnects below the thief, is
+        refused, and its supervisor respawns it with a fresh higher
+        base — mutual eviction that drains both RestartBudgets and
+        fails both hosts fast with nonzero exits.  Noisy fail-fast, not
+        the silent epsilon skew: distinguishing a live duplicate from a
+        dead predecessor's replacement would need gateway-side liveness
+        probing, which the idle-deadline reaper only provides after the
+        fact."""
         if ind is None:
             return None
+        evict: Optional[socket.socket] = None
         with self._slots_lock:
             if ind < self.local_actors:
                 return (f"actor slot {ind} is local to the learner host "
                         f"(local_actors={self.local_actors})")
-            if ind in self._active_slots:
-                return f"actor slot {ind} already connected"
-            self._active_slots.add(ind)
+            held = self._slots.get(ind)
+            if held is not None:
+                held_inc, held_conn = held
+                if incarnation <= held_inc:
+                    return (f"actor slot {ind} already connected "
+                            f"(incarnation {incarnation} <= {held_inc})")
+                evict = held_conn
+                self.fenced += 1
+            self._slots[ind] = (incarnation, conn)
+        if evict is not None:
+            # outside the lock: unblock the predecessor's serve thread;
+            # its release is identity-checked so it cannot free OUR claim
+            try:
+                evict.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         return None
+
+    def _fresh_tick(self, slot: Optional[int], seq: Optional[int]) -> bool:
+        """Dedup retransmitted T_TICKs: a tick whose T_CLOCK ack was lost
+        mid-blip is resent after reconnect, and applying it twice would
+        inflate the fleet-wide actor-step count (the learner's
+        max_replay_ratio gate) and the episode stats.  Seq numbers are
+        wall-clock-based like incarnations, so a replacement process
+        starts above its predecessor's high-water mark.  The map is not
+        cleared on slot release — it must outlive fencing and reconnects
+        — but a gateway RESTART forgets it: an ack lost across a restart
+        is the one residual double-count window (failure model)."""
+        if slot is None or seq is None:
+            return True
+        with self._slots_lock:
+            if seq <= self._tick_seq.get(slot, -1):
+                return False
+            self._tick_seq[slot] = seq
+            return True
+
+    def _release_slot(self, slot: Optional[int],
+                      conn: socket.socket) -> None:
+        with self._slots_lock:
+            self._conns.discard(conn)
+            if slot is None:
+                return
+            held = self._slots.get(slot)
+            if held is not None and held[1] is conn:
+                del self._slots[slot]
 
     def _serve(self, conn: socket.socket, addr) -> None:
         slot: Optional[int] = None
+        if self._idle_deadline and self._idle_deadline > 0:
+            conn.settimeout(self._idle_deadline)
         try:
             with conn:
                 while not self._stop.is_set():
                     ftype, payload = _recv_frame(conn)
+                    payload = self._faults.frame(payload)
                     if ftype == T_BYE:
                         return
                     elif ftype == T_EXP:
                         try:
-                            self.put_chunk(decode_chunk(payload))
+                            items = decode_chunk(payload)
+                        except ConnectionError:
+                            raise
+                        except Exception as e:
+                            # wire corruption / malformed peer: drop the
+                            # connection — never feed garbage into replay
+                            raise ConnectionError(
+                                f"undecodable EXP frame: {e!r}")
+                        try:
+                            self.put_chunk(items)
                         except ValueError:
                             # memory queue already closed: the run is over;
                             # answer with the stop-carrying clock instead of
@@ -215,7 +357,11 @@ class DcnGateway:
                         self.chunks_in += 1
                         _send_frame(conn, T_CLOCK, self._clock_payload())
                     elif ftype == T_GETP:
-                        (min_version,) = struct.unpack("!Q", payload)
+                        try:
+                            (min_version,) = struct.unpack("!Q", payload)
+                        except struct.error as e:
+                            raise ConnectionError(
+                                f"undecodable GETP frame: {e}")
                         got = self.param_store.fetch(min_version)
                         if got is None:
                             _send_frame(conn, T_PARAMS,
@@ -227,20 +373,35 @@ class DcnGateway:
                                 struct.pack("!Q", version)
                                 + np.ascontiguousarray(
                                     flat, dtype=np.float32).tobytes())
+                    elif ftype == T_PING:
+                        _send_frame(conn, T_CLOCK, self._clock_payload())
                     elif ftype == T_TICK:
-                        msg = json.loads(payload.decode())
-                        steps = int(msg.get("actor_steps", 0))
-                        if steps:
-                            self.clock.add_actor_steps(steps)
-                        kv = msg.get("stats")
-                        if kv:
-                            self.actor_stats.add(
-                                **{k: float(v) for k, v in kv.items()})
+                        msg = self._json(payload)
+                        try:
+                            steps = int(msg.get("actor_steps", 0))
+                            seq = msg.get("seq")
+                            seq = int(seq) if seq is not None else None
+                            kv = {k: float(v) for k, v
+                                  in (msg.get("stats") or {}).items()}
+                        except (TypeError, ValueError) as e:
+                            raise ConnectionError(
+                                f"undecodable TICK frame: {e}")
+                        if self._fresh_tick(slot, seq):
+                            if steps:
+                                self.clock.add_actor_steps(steps)
+                            if kv:
+                                self.actor_stats.add(**kv)
                         _send_frame(conn, T_CLOCK, self._clock_payload())
                     elif ftype == T_HELLO:
-                        msg = json.loads(payload.decode())
-                        ind = msg.get("process_ind")
-                        err = self._claim_slot(ind)
+                        msg = self._json(payload)
+                        try:
+                            ind = msg.get("process_ind")
+                            ind = int(ind) if ind is not None else None
+                            inc = int(msg.get("incarnation", 0))
+                        except (TypeError, ValueError) as e:
+                            raise ConnectionError(
+                                f"undecodable HELLO frame: {e}")
+                        err = self._claim_slot(ind, inc, conn)
                         if err is not None:
                             reply = json.loads(self._clock_payload())
                             reply["error"] = err
@@ -252,11 +413,16 @@ class DcnGateway:
                     else:
                         raise ConnectionError(f"bad frame type {ftype}")
         except (ConnectionError, OSError):
-            return  # peer went away; Ape-X tolerates actor churn
+            return  # peer went away (or idled out); churn is expected
         finally:
-            if slot is not None:
-                with self._slots_lock:
-                    self._active_slots.discard(slot)
+            self._release_slot(slot, conn)
+
+    @staticmethod
+    def _json(payload: bytes) -> dict:
+        try:
+            return json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ConnectionError(f"undecodable control frame: {e}")
 
     def close(self) -> None:
         self._stop.set()
@@ -264,6 +430,18 @@ class DcnGateway:
             self._srv.close()
         except OSError:
             pass
+        # the kernel only releases the listening port once the accept
+        # thread leaves its accept() syscall — join it, or an immediate
+        # rebind on the same port (restart_gateway) races into EADDRINUSE
+        self._accept_thread.join(2.0)
+        with self._slots_lock:
+            conns = list(self._conns)
+        for c in conns:
+            # unblock serve threads parked in recv so join() is prompt
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         for t in self._threads:
             t.join(1.0)
 
@@ -292,18 +470,80 @@ def feed_queue_of(memory_handles) -> Callable[[list], None]:
 # actor-host client + adapters
 # ---------------------------------------------------------------------------
 
+class DcnDisconnected(ConnectionError):
+    """Terminal session loss: the reconnect budget is spent (or the
+    client is closing).  Subclasses ConnectionError so transport-level
+    best-effort paths (final flushes) swallow it, while the actor's main
+    loop surfaces it as a nonzero exit for the RestartBudget."""
+
+
+class DcnRefused(RuntimeError):
+    """The gateway answered the HELLO with an error (slot conflict,
+    local-slot claim).  A distinct type so supervisors can classify it
+    as a session condition without catching unrelated RuntimeErrors —
+    notably faults.InjectedCrash, which must never be mistaken for a
+    network problem."""
+
+
 class DcnClient:
     """One connection to the gateway, shared by the adapters of one actor
-    process.  All requests are synchronous request/reply under a lock; every
-    reply refreshes the cached learner clock."""
+    process.  All requests are synchronous request/reply under a lock;
+    every reply refreshes the cached learner clock.
+
+    A send/recv failure mid-RPC enters the reconnect path: redial with
+    exponential backoff (bounded by ``reconnect_timeout``), re-HELLO with
+    a bumped incarnation — fencing off this client's own half-open
+    predecessor on the gateway — then retransmit the one unacknowledged
+    frame.  The caller never observes the blip; a terminal failure raises
+    ``DcnDisconnected`` and latches ``disconnected``.
+
+    ``stop`` and ``disconnected`` are disjoint: ``stop`` means the
+    learner's clock declared the run over (exit 0); ``disconnected``
+    means the session died (exit nonzero, supervision restarts us).
+
+    A background heartbeat thread pings after ``heartbeat_interval`` idle
+    seconds so a partitioned gateway is detected (and reconnected to)
+    even while the actor is busy between RPCs, and so the gateway's idle
+    deadline never reaps a healthy-but-quiet actor.
+    """
 
     def __init__(self, address: Tuple[str, int], process_ind: int = 0,
-                 connect_timeout: float = 60.0, retries: int = 20):
+                 connect_timeout: float = 60.0, retries: int = 20,
+                 incarnation: Optional[int] = None,
+                 heartbeat_interval: Optional[float] = None,
+                 reply_deadline: Optional[float] = None,
+                 reconnect_timeout: Optional[float] = None,
+                 faults: Optional[FaultInjector] = None):
         self.address = address
         self.process_ind = process_ind
         self._lock = threading.RLock()
         self.learner_step = 0
-        self.stop = threading.Event()
+        self.stop = threading.Event()          # learner said stop (T_CLOCK)
+        self.disconnected = threading.Event()  # session terminally lost
+        # wall-clock-derived base so a REPLACEMENT process (fresh object,
+        # no memory of its predecessor's count) still fences a half-open
+        # slot left by the old incarnation; reconnects bump it by 1
+        self.incarnation = (int(incarnation) if incarnation is not None
+                            else time.time_ns() // 1_000_000)
+        # tick dedup sequence, same wall-clock base trick: the gateway
+        # drops a retransmitted tick (seq <= its per-slot high-water)
+        # instead of double-counting actor steps/stats, and a replacement
+        # process's fresh counter still lands above its predecessor's
+        self._tick_seq = time.time_ns() // 1_000_000
+        self.reconnects = 0
+        self._closed = False
+        self._faults = (faults if faults is not None
+                        else FaultInjector.from_env("client"))
+        self._heartbeat_interval = (
+            _env_float("DCN_HEARTBEAT_INTERVAL", 10.0)
+            if heartbeat_interval is None else heartbeat_interval)
+        self._reply_deadline = (
+            _env_float("DCN_REPLY_DEADLINE", 180.0)
+            if reply_deadline is None else reply_deadline)
+        self._reconnect_timeout = (
+            _env_float("DCN_RECONNECT_TIMEOUT", 30.0)
+            if reconnect_timeout is None else reconnect_timeout)
+        self._last_rpc = time.monotonic()
         deadline = time.monotonic() + connect_timeout
         delay = 0.1
         while True:
@@ -316,34 +556,180 @@ class DcnClient:
                 retries -= 1
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
-        # blocking from here on: a slow gateway (learner jit compile,
-        # ingest-queue backpressure) must stall the actor — the correct
-        # flow control — not masquerade as a dead peer; death is detected
-        # by TCP reset/close, same as the local runtime monitor
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._request(T_HELLO, json.dumps(
-            {"role": "actor", "process_ind": process_ind}).encode())
+        self._configure(self._sock)
+        self._request(T_HELLO, self._hello_payload())
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if self._heartbeat_interval and self._heartbeat_interval > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"dcn-heartbeat-{process_ind}", daemon=True)
+            self._hb_thread.start()
+
+    # -- session plumbing ---------------------------------------------------
+
+    def _configure(self, sock: socket.socket) -> None:
+        # bounded reply wait: legitimate backpressure stalls under this
+        # deadline; a frozen/partitioned peer trips it into the reconnect
+        # path instead of stalling the actor forever (<=0 restores the
+        # old unbounded-blocking behaviour)
+        sock.settimeout(self._reply_deadline
+                        if self._reply_deadline and self._reply_deadline > 0
+                        else None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _hello_payload(self) -> bytes:
+        return json.dumps({"role": "actor",
+                           "process_ind": self.process_ind,
+                           "incarnation": self.incarnation}).encode()
+
+    def _handle_reply(self, rtype: int, rpayload: bytes) -> None:
+        if rtype != T_CLOCK:
+            return
+        msg = json.loads(rpayload.decode())
+        self.learner_step = int(msg["learner_step"])
+        if msg.get("stop"):
+            self.stop.set()
+        if "error" in msg:  # e.g. actor-slot conflict at HELLO
+            self.disconnected.set()
+            raise DcnRefused(f"gateway refused: {msg['error']}")
+
+    def _terminal(self, why: str) -> DcnDisconnected:
+        # a close()-initiated abort is not a session LOSS: latching
+        # ``disconnected`` here would let a heartbeat racing a clean
+        # shutdown flip a run-complete exit into EXIT_DISCONNECTED
+        # (fleet._remote_actor_main reads the flag after close())
+        if not self._closed:
+            self.disconnected.set()
+        return DcnDisconnected(
+            f"DCN session to {self.address} lost (slot "
+            f"{self.process_ind}): {why}")
+
+    def _reconnect(self) -> Tuple[int, bytes]:
+        """Redial + re-HELLO under the request lock; returns the HELLO
+        reply on success, raises DcnDisconnected when the budget is spent
+        (or the client is stopping — with the run over or the process
+        closing there is nothing left to deliver)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + self._reconnect_timeout
+        delay = 0.05
+        while True:
+            if self._closed:
+                raise self._terminal("client closed")
+            if self.stop.is_set():
+                raise self._terminal("stop already set")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise self._terminal(
+                    f"reconnect budget ({self._reconnect_timeout:.1f}s) "
+                    f"exhausted")
+            try:
+                sock = socket.create_connection(
+                    self.address, timeout=max(0.1, min(5.0, remaining)))
+            except OSError:
+                time.sleep(min(delay, max(0.0, remaining)))
+                delay = min(delay * 2, 1.0)
+                continue
+            # the HELLO exchange is budgeted by the reconnect deadline,
+            # not the (much longer) reply deadline: a frozen gateway whose
+            # kernel backlog still accepts connects must not stretch a
+            # 30 s reconnect budget into a 180 s reply wait
+            sock.settimeout(max(0.1, min(self._reply_deadline, remaining)
+                                if self._reply_deadline
+                                and self._reply_deadline > 0
+                                else remaining))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.incarnation += 1
+            try:
+                _send_frame(sock, T_HELLO, self._hello_payload())
+                rtype, rpayload = _recv_frame(sock)
+            except (ConnectionError, OSError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                time.sleep(min(delay, max(0.0, remaining)))
+                delay = min(delay * 2, 1.0)
+                continue
+            self._configure(sock)  # restore the steady-state reply deadline
+            self._sock = sock
+            self.reconnects += 1
+            try:
+                self._handle_reply(rtype, rpayload)
+            except DcnRefused as e:
+                # HELLO refused: the slot is held at >= our incarnation —
+                # a live duplicate actor owns it; retrying cannot win
+                raise self._terminal(str(e)) from e
+            return rtype, rpayload
+
+    # reconnect-then-REFUSAL cycles one RPC may consume before the frame
+    # is declared poison: each counted cycle means the session redialled
+    # FINE and the gateway then actively dropped this exact frame again
+    # (a chunk it can never decode, a serve-side crash on apply) —
+    # without a cap the actor livelocks forever instead of exiting for
+    # the supervisor.  Reply TIMEOUTS never count: a deadline trip is
+    # legitimate ingest backpressure (or a frozen peer, which the
+    # reconnect budget handles) and must stall the actor, not kill it.
+    _MAX_RETRANSMITS = 5
 
     def _request(self, ftype: int, payload: bytes) -> Tuple[int, bytes]:
         with self._lock:
+            if self.disconnected.is_set() or self._closed:
+                raise self._terminal("session already closed")
+            retransmits = 0
+            while True:
+                try:
+                    wire = self._faults.frame(payload)
+                    _send_frame(self._sock, ftype, wire)
+                    rtype, rpayload = _recv_frame(self._sock)
+                    break
+                except (ConnectionError, OSError) as e:
+                    timed_out = isinstance(e, socket.timeout)
+                    if (not timed_out
+                            and retransmits >= self._MAX_RETRANSMITS):
+                        raise self._terminal(
+                            f"frame type {ftype} refused "
+                            f"{retransmits}x across fresh sessions "
+                            f"(poison frame?)")
+                    reply = self._reconnect()
+                    if ftype == T_HELLO:
+                        # the reconnect's own HELLO already (re)established
+                        # the session — retransmitting would bounce off the
+                        # fresh claim as a same-incarnation duplicate
+                        rtype, rpayload = reply
+                        break
+                    if not timed_out:
+                        retransmits += 1
+                    # loop retransmits the one unacked frame
+            self._last_rpc = time.monotonic()
+            self._handle_reply(rtype, rpayload)
+            return rtype, rpayload
+
+    # -- heartbeats ---------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        interval = self._heartbeat_interval
+        while not self._hb_stop.wait(min(interval / 4.0, 1.0)):
+            if self.disconnected.is_set():
+                return
+            if time.monotonic() - self._last_rpc < interval:
+                continue
             try:
-                _send_frame(self._sock, ftype, payload)
-                rtype, rpayload = _recv_frame(self._sock)
+                self.ping()
             except (ConnectionError, OSError):
-                # learner host gone: treat as global stop, as the runtime
-                # monitor would locally (runtime.py _monitor)
-                self.stop.set()
-                raise
-        if rtype == T_CLOCK:
-            msg = json.loads(rpayload.decode())
-            self.learner_step = int(msg["learner_step"])
-            if msg.get("stop"):
-                self.stop.set()
-            if "error" in msg:  # e.g. actor-slot conflict at HELLO
-                self.stop.set()
-                raise RuntimeError(f"gateway refused: {msg['error']}")
-        return rtype, rpayload
+                return  # terminal states are latched by the request path
+            # anything else (notably faults.InjectedCrash) propagates:
+            # a crash drill must die loudly, never as a quiet hb death
+
+    def ping(self) -> int:
+        """Heartbeat RPC; refreshes the cached learner clock."""
+        self._request(T_PING, b"")
+        return self.learner_step
+
+    # -- RPC surface --------------------------------------------------------
 
     def send_chunk(self, items: list) -> None:
         self._request(T_EXP, encode_chunk(items))
@@ -361,15 +747,25 @@ class DcnClient:
         msg: Dict[str, Any] = {"actor_steps": actor_steps}
         if stats:
             msg["stats"] = stats
-        self._request(T_TICK, json.dumps(msg).encode())
+        with self._lock:
+            # seq assigned under the request lock so ticks hit the wire
+            # in seq order; a retransmit reuses the SAME payload bytes,
+            # which is exactly what lets the gateway spot the duplicate
+            self._tick_seq += 1
+            msg["seq"] = self._tick_seq
+            self._request(T_TICK, json.dumps(msg).encode())
         return self.learner_step
 
     def close(self) -> None:
+        self._closed = True
+        if self._hb_thread is not None:
+            self._hb_stop.set()
+            self._hb_thread.join(2.0)
         try:
             with self._lock:
                 _send_frame(self._sock, T_BYE, b"")
                 self._sock.close()
-        except OSError:
+        except (ConnectionError, OSError):
             pass
 
 
@@ -455,10 +851,15 @@ class RemoteClock:
         try:
             self._client.tick(actor_steps=pending)
         except (ConnectionError, OSError):
-            pass  # stop is set by the client; done() will see it
+            # terminal disconnect (transient ones retry inside the
+            # client): keep the steps — they are the fleet-wide Ape-X
+            # step count, and done() ends this loop via ``disconnected``,
+            # so a dropped tick would silently undercount the run
+            self._pending += pending
 
     def done(self, steps: int) -> bool:
-        if self._client.stop.is_set():
+        if (self._client.stop.is_set()
+                or self._client.disconnected.is_set()):
             return True
         if time.monotonic() - self._last_flush > self._max_age:
             self.flush()

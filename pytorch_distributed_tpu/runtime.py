@@ -196,9 +196,18 @@ class Topology:
         trips the stop event so the run fails fast instead of degrading
         silently.  Restart/GRACE policy shared with the fleet actor-host
         supervisor via utils/supervision.RestartBudget."""
-        from pytorch_distributed_tpu.utils.supervision import RestartBudget
+        from pytorch_distributed_tpu.utils.supervision import (
+            RestartBudget, describe_exit,
+        )
 
         budget = RestartBudget(max_restarts=max_restarts)
+        for _p, role, ind, _args in self._proc_meta:
+            # record first incarnations: the grace-period budget reset
+            # only applies to slots with a KNOWN long-lived incarnation
+            # (RestartBudget.request_restart no longer treats unborn
+            # slots as ancient ones)
+            if role == "actor":
+                budget.note_birth(ind)
         while not self.clock.stop.is_set():
             for i, (p, role, ind, args) in enumerate(list(self._proc_meta)):
                 if p.exitcode in (None, 0):
@@ -207,14 +216,14 @@ class Topology:
                         and budget.request_restart(ind) is not None:
                     budget.note_birth(ind)
                     print(f"[runtime] actor-{ind} died "
-                          f"(exit {p.exitcode}); restart "
+                          f"({describe_exit(p.exitcode)}); restart "
                           f"{budget.count(ind)}/{max_restarts}")
                     self._workers.remove(p)
                     self._proc_meta.remove((p, role, ind, args))
                     self._spawn(role, ind, args)
                 else:
                     print(f"[runtime] {role}-{ind} died "
-                          f"(exit {p.exitcode}); stopping run")
+                          f"({describe_exit(p.exitcode)}); stopping run")
                     self.clock.stop.set()
                     return
             time.sleep(poll)

@@ -1,0 +1,192 @@
+"""Deterministic fault injection for the DCN session layer.
+
+The fault-tolerance claims of the cross-host plane (parallel/dcn.py:
+transparent reconnect, slot fencing, heartbeat liveness) are only worth
+what the failure drills behind them prove.  This module is that drill
+harness: a seed-driven injector that the DCN endpoints consult once per
+frame operation, so a test (tests/test_chaos.py) or a soak run
+(tools/chaos_soak.py) can sever, delay, blackhole, or corrupt the wire —
+or kill a process outright — at an exactly reproducible point in the
+frame stream.
+
+Frame indices are endpoint-local: a ``DcnClient`` counts the frames it
+*sends* (HELLO is frame 0), a ``DcnGateway`` counts the frames it
+*receives* across all connections.  Scripted specs name those indices
+directly; the random mode draws the schedule from a seeded Generator so
+a soak failure replays from its seed alone.
+
+Actions (``action@frame`` or ``action@frame:arg``):
+
+- ``sever@N``          — raise ``InjectedDisconnect`` at frame N (the
+  connection "dies"; the client's reconnect path must recover).
+- ``delay@N:S``        — sleep S seconds before frame N (slow network /
+  GC pause; must NOT trip any liveness deadline shorter than S).
+- ``blackhole@N:S``    — partition: stall S seconds, then sever.  Models
+  partition-then-heal — the reconnect after the sever lands on a healed
+  network.
+- ``corrupt@N``        — flip a byte of frame N's payload (wire
+  corruption; the peer must reject the frame and drop the connection,
+  never decode garbage into the replay plane).
+- ``crash@N``          — raise ``InjectedCrash`` at frame N.  Uncaught by
+  design: an actor process dies nonzero (its RestartBudget engages), a
+  gateway serve thread dies and frees its slot.
+
+Injectors are wired through env vars so fault schedules reach spawn
+children without plumbing: ``DCN_FAULTS_CLIENT`` / ``DCN_FAULTS_GATEWAY``
+hold either a scripted spec or ``random:SEED`` (see
+``FaultInjector.from_env``); fleet.py exposes them as ``--faults-client``
+/ ``--faults-gateway`` CLI knobs.  No spec = a null injector whose
+per-frame cost is one lock + dict probe.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+FaultEvent = Tuple[int, str, float]  # (frame index, action, arg)
+
+_ACTIONS = ("sever", "delay", "blackhole", "corrupt", "crash")
+
+# default per-frame probabilities for the random mode — light enough that
+# a healthy session layer rides through, frequent enough that a soak of a
+# few thousand frames exercises every recovery path
+_RANDOM_RATES = {"sever": 0.002, "delay": 0.003, "corrupt": 0.001}
+_RANDOM_DELAY_S = 0.05
+
+
+class InjectedDisconnect(ConnectionError):
+    """A fault-injected connection death — handled exactly like a real
+    socket error by the session layer (that equivalence is the point)."""
+
+
+class InjectedCrash(RuntimeError):
+    """A fault-injected process death — deliberately NOT a
+    ConnectionError, so no transport-level handler swallows it; it
+    propagates until the worker exits nonzero."""
+
+
+def parse_faults(spec: str) -> List[FaultEvent]:
+    """``"sever@5,delay@3:0.5"`` -> [(5, "sever", 0.0), (3, "delay", 0.5)].
+    Raises ValueError on malformed specs — a fault drill that silently
+    injects nothing proves nothing."""
+    events: List[FaultEvent] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            action, rest = part.split("@", 1)
+            if ":" in rest:
+                at_s, arg_s = rest.split(":", 1)
+                at, arg = int(at_s), float(arg_s)
+            else:
+                at, arg = int(rest), 0.0
+        except ValueError as e:
+            raise ValueError(f"bad fault event {part!r} "
+                             f"(want action@frame[:arg])") from e
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r} "
+                             f"(known: {_ACTIONS})")
+        events.append((at, action, arg))
+    return events
+
+
+class FaultInjector:
+    """One injector per instrumented endpoint.  ``frame(payload)`` is the
+    single hook: it counts the operation, runs any events scheduled at
+    that index (sleep / raise), and returns the — possibly corrupted —
+    payload.  Thread-safe: a gateway shares one injector across its
+    serve threads, so the frame counter is a global order over the
+    gateway's receive stream."""
+
+    def __init__(self, events: Iterable[FaultEvent] = (), name: str = ""):
+        self.name = name
+        self._lock = threading.Lock()
+        self._n = 0
+        self._by_frame: Dict[int, List[Tuple[str, float]]] = {}
+        for at, action, arg in events:
+            self._by_frame.setdefault(at, []).append((action, arg))
+        self.injected = 0  # events fired so far (observability for soaks)
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def scripted(cls, spec: str, name: str = "") -> "FaultInjector":
+        return cls(parse_faults(spec), name=name)
+
+    @classmethod
+    def random(cls, seed: int, horizon: int = 4000,
+               rates: Optional[Dict[str, float]] = None,
+               name: str = "") -> "FaultInjector":
+        """A reproducible random schedule over the first ``horizon``
+        frames.  ``crash`` is never drawn here — random process kills
+        belong to the orchestrator (tools/chaos_soak.py), which owns the
+        restart story; the wire injector only breaks the wire."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        events: List[FaultEvent] = []
+        for action, p in (rates if rates is not None
+                          else _RANDOM_RATES).items():
+            hits = np.nonzero(rng.random(horizon) < p)[0]
+            arg = _RANDOM_DELAY_S if action in ("delay", "blackhole") else 0.0
+            events.extend((int(at), action, arg) for at in hits)
+        return cls(events, name=name)
+
+    @classmethod
+    def from_env(cls, role: str) -> "FaultInjector":
+        """``DCN_FAULTS_CLIENT`` / ``DCN_FAULTS_GATEWAY``: a scripted
+        spec, or ``random:SEED[:HORIZON]``.  Unset/empty -> null
+        injector.  Per-process (spawn children inherit the env), which is
+        what a kill-actor-at-step-N drill needs."""
+        spec = os.environ.get(f"DCN_FAULTS_{role.upper()}", "").strip()
+        if not spec:
+            return cls(name=role)
+        if spec.startswith("random:"):
+            parts = spec.split(":")
+            seed = int(parts[1])
+            horizon = int(parts[2]) if len(parts) > 2 else 4000
+            return cls.random(seed, horizon=horizon, name=role)
+        return cls.scripted(spec, name=role)
+
+    # -- the hook ------------------------------------------------------------
+
+    def frame(self, payload: bytes = b"") -> bytes:
+        """Account one frame operation; fire its scheduled events."""
+        with self._lock:
+            n = self._n
+            self._n += 1
+            events = self._by_frame.get(n)
+        if not events:
+            return payload
+        for action, arg in events:
+            self.injected += 1
+            if action == "delay":
+                time.sleep(arg)
+            elif action == "sever":
+                raise InjectedDisconnect(
+                    f"[faults:{self.name}] injected sever at frame {n}")
+            elif action == "blackhole":
+                time.sleep(arg)
+                raise InjectedDisconnect(
+                    f"[faults:{self.name}] blackhole healed after {arg}s "
+                    f"at frame {n}")
+            elif action == "crash":
+                raise InjectedCrash(
+                    f"[faults:{self.name}] injected crash at frame {n}")
+            elif action == "corrupt":
+                if payload:
+                    mutated = bytearray(payload)
+                    mutated[len(mutated) // 2] ^= 0xFF
+                    payload = bytes(mutated)
+                else:
+                    payload = b"\xff"  # give empty frames something to break
+        return payload
+
+    @property
+    def frames_seen(self) -> int:
+        with self._lock:
+            return self._n

@@ -17,6 +17,25 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+# Worker exit-code vocabulary shared by the supervisors.  Distinguishing
+# "crashed" from "lost its session" matters in logs: a fleet of actors
+# all exiting EXIT_DISCONNECTED points at the learner host / network, not
+# at the actor code (fleet.py maps DcnClient.disconnected to this code).
+EXIT_OK = 0
+EXIT_CRASH = 1
+EXIT_DISCONNECTED = 3
+
+
+def describe_exit(code: Optional[int]) -> str:
+    """Human-readable worker exit for supervisor logs."""
+    if code == EXIT_OK:
+        return "exit 0 (run complete)"
+    if code == EXIT_DISCONNECTED:
+        return f"exit {code} (DCN session lost)"
+    if code is not None and code < 0:
+        return f"signal {-code}"
+    return f"exit {code} (crash)"
+
 
 class RestartBudget:
     """``request_restart(slot)`` returns the respawn delay in seconds —
@@ -41,7 +60,13 @@ class RestartBudget:
         return self._restarts.get(slot, 0)
 
     def request_restart(self, slot: int) -> Optional[float]:
-        if time.monotonic() - self._born.get(slot, 0.0) > self.grace:
+        born = self._born.get(slot)
+        # only a RECORDED incarnation that outlived the grace period
+        # proves the crash isolated; a slot with no recorded birth must
+        # not read as an ancient incarnation (it used to — monotonic==0
+        # birth made every unborn crash "old", silently refilling the
+        # budget forever for callers that skip note_birth)
+        if born is not None and time.monotonic() - born > self.grace:
             self._restarts[slot] = 0  # isolated crash, not a crash loop
         n = self._restarts.get(slot, 0)
         if n >= self.max_restarts:

@@ -1,0 +1,465 @@
+"""Chaos drills for the fault-tolerant DCN session layer
+(parallel/dcn.py failure model): gateway kill+restart, partitions that
+heal, wire corruption, half-open-slot fencing, heartbeat liveness — each
+driven deterministically through utils/faults.py or direct gateway
+surgery.  The randomized long-haul version is tools/chaos_soak.py; its
+SyntheticActor doubles as this suite's fleet driver so every scenario
+short of the real-learner run executes in seconds without jax."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+from pytorch_distributed_tpu.config import build_options
+from pytorch_distributed_tpu.parallel.dcn import (
+    T_CLOCK, T_HELLO, T_TICK, DcnClient, DcnDisconnected, DcnGateway,
+    RemoteClock, _recv_frame, _send_frame,
+)
+from pytorch_distributed_tpu.utils.faults import (
+    FaultInjector, InjectedCrash, InjectedDisconnect, parse_faults,
+)
+from tools.chaos_soak import ChunkLog, SyntheticActor, soak, tagged_transition
+
+
+@pytest.fixture()
+def plane():
+    """Gateway + its learner-plane fixtures, chunk deliveries tag-logged."""
+    clock = GlobalClock()
+    stats = ActorStats()
+    store = ParamStore(8)
+    store.publish(np.zeros(8, dtype=np.float32))
+    log = ChunkLog()
+    gw = DcnGateway(store, clock, stats, put_chunk=log,
+                    host="127.0.0.1", port=0)
+    holder = {"gw": gw}
+    yield holder, store, clock, stats, log
+    holder["gw"].close()
+
+
+def _client(gw, slot=0, **kw):
+    kw.setdefault("heartbeat_interval", 0)  # drills drive RPCs explicitly
+    kw.setdefault("reconnect_timeout", 10.0)
+    return DcnClient(("127.0.0.1", gw.port), process_ind=slot, **kw)
+
+
+class TestFaultInjector:
+    def test_parse_and_fire(self):
+        inj = FaultInjector(parse_faults("delay@1:0.01,sever@2,corrupt@3"))
+        assert inj.frame(b"a") == b"a"          # frame 0: clean
+        assert inj.frame(b"b") == b"b"          # frame 1: delayed only
+        with pytest.raises(InjectedDisconnect):
+            inj.frame(b"c")                     # frame 2
+        assert inj.frame(b"dd") != b"dd"        # frame 3: corrupted
+        assert inj.injected == 3
+
+    def test_crash_is_not_a_connection_error(self):
+        inj = FaultInjector.scripted("crash@0")
+        with pytest.raises(InjectedCrash) as ei:
+            inj.frame()
+        assert not isinstance(ei.value, ConnectionError)
+
+    def test_bad_specs_rejected(self):
+        with pytest.raises(ValueError):
+            parse_faults("sever")
+        with pytest.raises(ValueError):
+            parse_faults("teleport@3")
+
+    def test_random_is_reproducible(self):
+        a = FaultInjector.random(42)
+        b = FaultInjector.random(42)
+        assert a._by_frame == b._by_frame
+        assert FaultInjector.random(43)._by_frame != a._by_frame
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("DCN_FAULTS_CLIENT", "sever@7")
+        inj = FaultInjector.from_env("client")
+        assert inj._by_frame == {7: [("sever", 0.0)]}
+        monkeypatch.delenv("DCN_FAULTS_CLIENT")
+        assert FaultInjector.from_env("client")._by_frame == {}
+
+
+class TestReconnect:
+    def test_transparent_reconnect_after_gateway_restart(self, plane):
+        holder, store, clock, stats, log = plane
+        gw = holder["gw"]
+        client = _client(gw)
+        inc0 = client.incarnation
+        gw.close()
+        holder["gw"] = gw2 = DcnGateway(
+            store, clock, stats, put_chunk=log,
+            host="127.0.0.1", port=gw.port)
+        # the tick rides through: redial, re-HELLO, retransmit — the
+        # caller never sees the blip
+        client.tick(actor_steps=5)
+        assert clock.actor_step.value == 5
+        assert client.reconnects == 1
+        assert client.incarnation > inc0
+        assert gw2.active_slots == {0: client.incarnation}
+        assert not client.disconnected.is_set()
+        client.close()
+
+    def test_unacked_chunk_resent_after_sever(self, plane):
+        holder, *_rest, log = plane
+        gw = holder["gw"]
+        # frame 0 is HELLO; frame 1 (the first EXP) dies before hitting
+        # the wire — the reconnect must re-HELLO (fencing its own
+        # half-open predecessor) and retransmit that one chunk
+        client = _client(gw, faults=FaultInjector.scripted("sever@1"))
+        client.send_chunk([(tagged_transition(99), None)])
+        assert log.tags == [99]
+        assert client.reconnects == 1
+        # the predecessor is either fenced (HELLO beat its FIN) or was
+        # already reaped — either way the new incarnation owns the slot;
+        # deterministic fencing is pinned by the two-claimant tests
+        assert gw.active_slots == {0: client.incarnation}
+        client.close()
+
+    def test_corrupt_frame_rejected_then_resent(self, plane):
+        holder, *_rest, log = plane
+        gw = holder["gw"]
+        client = _client(gw, faults=FaultInjector.scripted("corrupt@1"))
+        client.send_chunk([(tagged_transition(7), None)])
+        # the gateway must never decode garbage into the replay plane:
+        # it drops the connection, the client retransmits clean
+        assert log.tags == [7]
+        assert client.reconnects == 1
+        client.close()
+
+    def test_blackhole_partition_then_heal(self, plane):
+        holder, *_rest, log = plane
+        gw = holder["gw"]
+        client = _client(
+            gw, faults=FaultInjector.scripted("blackhole@1:0.4"))
+        t0 = time.monotonic()
+        client.send_chunk([(tagged_transition(1), None)])
+        assert time.monotonic() - t0 >= 0.4  # stalled through the outage
+        assert log.tags == [1]
+        assert client.reconnects == 1
+        client.close()
+
+    def test_terminal_disconnect_raises_nonzero_path(self, plane):
+        holder, *_rest = plane
+        gw = holder["gw"]
+        client = _client(gw, reconnect_timeout=0.8)
+        rclock = RemoteClock(client, flush_every=10 ** 9)
+        gw.close()  # and never comes back
+        rclock._pending = 37
+        t0 = time.monotonic()
+        rclock.flush()  # swallows the terminal error, keeps the steps
+        assert time.monotonic() - t0 >= 0.7
+        assert client.disconnected.is_set()
+        assert not client.stop.is_set()   # a blip is NOT "learner said stop"
+        assert rclock._pending == 37      # actor-steps not silently lost
+        assert rclock.done(steps=10 ** 9)
+        with pytest.raises(DcnDisconnected):
+            client.tick(actor_steps=1)    # latched: fast-fail, no redial
+        client.close()
+
+    def test_poison_frame_goes_terminal_not_livelock(self, plane):
+        """A frame the gateway can NEVER accept (every retransmit
+        corrupted) must exhaust the retransmit cap and raise terminally
+        — not redial/resend forever with each cycle granting a fresh
+        reconnect budget."""
+        holder, *_rest = plane
+        gw = holder["gw"]
+        spec = ",".join(f"corrupt@{i}" for i in range(1, 12))
+        client = _client(gw, faults=FaultInjector.scripted(spec))
+        with pytest.raises(DcnDisconnected, match="poison"):
+            client.send_chunk([(tagged_transition(1), None)])
+        assert client.disconnected.is_set()
+        client.close()
+
+    def test_injected_crash_propagates_uncaught(self, plane):
+        holder, *_rest = plane
+        gw = holder["gw"]
+        client = _client(gw, faults=FaultInjector.scripted("crash@1"))
+        with pytest.raises(InjectedCrash):
+            client.tick(actor_steps=1)
+        client.close()
+
+
+class TestWireHardening:
+    def test_retransmitted_tick_not_double_counted(self, plane):
+        """A tick whose ack was lost is resent verbatim after reconnect;
+        the gateway's per-slot seq high-water must count it exactly once
+        (actor_step gates the learner's max_replay_ratio throttle)."""
+        holder, _store, clock, _stats, _log = plane
+        gw = holder["gw"]
+        s = socket.create_connection(("127.0.0.1", gw.port))
+        try:
+            _send_frame(s, T_HELLO, json.dumps(
+                {"role": "actor", "process_ind": 0,
+                 "incarnation": 1}).encode())
+            assert _recv_frame(s)[0] == T_CLOCK
+            tick = json.dumps({"actor_steps": 40, "seq": 9,
+                               "stats": {"nepisodes": 2.0}}).encode()
+            _send_frame(s, T_TICK, tick)
+            _recv_frame(s)
+            _send_frame(s, T_TICK, tick)  # the retransmit: same bytes
+            _recv_frame(s)
+            assert clock.actor_step.value == 40
+            _send_frame(s, T_TICK, json.dumps(
+                {"actor_steps": 2, "seq": 10}).encode())
+            _recv_frame(s)
+            assert clock.actor_step.value == 42  # fresh seq still counts
+        finally:
+            s.close()
+
+    def test_malformed_hello_drops_connection_cleanly(self, plane):
+        """A JSON-valid HELLO with wrong-typed fields must drop the
+        connection like any other malformed frame — not kill the serve
+        thread with an uncaught TypeError."""
+        holder, *_ = plane
+        gw = holder["gw"]
+        s = socket.create_connection(("127.0.0.1", gw.port))
+        try:
+            _send_frame(s, T_HELLO, json.dumps(
+                {"role": "actor", "process_ind": "not-a-slot"}).encode())
+            with pytest.raises(ConnectionError):
+                while True:
+                    _recv_frame(s)
+        finally:
+            s.close()
+        assert gw.active_slots == {}
+        survivor = _client(gw, slot=1)  # gateway still fully serviceable
+        survivor.tick(actor_steps=1)
+        survivor.close()
+
+
+class TestHeartbeat:
+    def test_idle_heartbeat_keeps_clock_fresh(self, plane):
+        holder, _store, clock, *_rest = plane
+        gw = holder["gw"]
+        client = _client(gw, heartbeat_interval=0.15)
+        clock.set_learner_step(77)
+        deadline = time.monotonic() + 5
+        while client.learner_step != 77:  # no explicit RPC from us
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        client.close()
+
+    def test_heartbeat_reconnects_through_gateway_restart(self, plane):
+        holder, store, clock, stats, log = plane
+        gw = holder["gw"]
+        client = _client(gw, heartbeat_interval=0.15)
+        gw.close()
+        holder["gw"] = gw2 = DcnGateway(
+            store, clock, stats, put_chunk=log,
+            host="127.0.0.1", port=gw.port)
+        # no main-thread RPC at all: the heartbeat alone must discover
+        # the death and re-establish the session + slot claim
+        deadline = time.monotonic() + 10
+        while not gw2.active_slots:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        # the gateway-side claim is visible before the heartbeat thread
+        # returns from its HELLO and bumps the counter — poll, don't assert
+        while client.reconnects < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert gw2.active_slots == {0: client.incarnation}
+        client.close()
+
+    def test_gateway_idle_deadline_reaps_frozen_peer(self):
+        clock = GlobalClock()
+        gw = DcnGateway(ParamStore(8), clock, ActorStats(),
+                        put_chunk=lambda items: None,
+                        host="127.0.0.1", port=0, idle_deadline=0.4)
+        try:
+            frozen = _client(gw, slot=4)  # heartbeats off = frozen actor
+            assert gw.active_slots == {4: frozen.incarnation}
+            deadline = time.monotonic() + 5
+            while gw.active_slots:  # reaped without any disconnect event
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            # the freed slot is reclaimable by a replacement process
+            fresh = _client(gw, slot=4)
+            assert gw.active_slots == {4: fresh.incarnation}
+            fresh.close()
+            frozen.close()
+        finally:
+            gw.close()
+
+
+class TestChaosFleet:
+    """The acceptance drill: a fleet of session-layer actors rides
+    through a gateway kill+restart with zero abandoned slots, fenced
+    re-claims, resent unacked chunks, and no duplicate-slot skew."""
+
+    def test_gateway_restart_mid_run_zero_lost(self, plane):
+        holder, store, clock, stats, log = plane
+        gw = holder["gw"]
+        fleet = [SyntheticActor(("127.0.0.1", gw.port), slot=i, pace=0.001,
+                                client_kwargs=dict(
+                                    heartbeat_interval=0.25,
+                                    reconnect_timeout=10.0)).start()
+                 for i in range(3)]
+        deadline = time.monotonic() + 10
+        while len(log.tags) < 30:  # fleet is demonstrably flowing
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        gw.close()  # kill the gateway mid-run...
+        holder["gw"] = gw2 = DcnGateway(
+            store, clock, stats, put_chunk=log,
+            host="127.0.0.1", port=gw.port)
+        deadline = time.monotonic() + 20
+        while set(gw2.active_slots) != {0, 1, 2}:  # ...everyone re-claims
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        marker = len(log.tags)
+        while len(log.tags) < marker + 30:  # and keeps delivering
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        clock.set_learner_step(10)
+        clock.stop.set()
+        for a in fleet:
+            a.thread.join(15)
+            assert not a.thread.is_alive()
+            assert a.outcome == "stopped"  # zero abandoned slots
+            assert a.client.reconnects >= 1
+            assert a.step_regressions == 0
+        # at-least-once delivery: every acked chunk arrived (duplicates
+        # allowed, loss is not), and no foreign slots ever appeared
+        seen = log.seen()
+        for a in fleet:
+            missing = [t for t in a.acked_tags if t not in seen]
+            assert missing == []
+        # clean closes free the slots — asynchronously: T_BYE is processed
+        # on the gateway's serve thread after the actor thread has joined
+        deadline = time.monotonic() + 10
+        while gw2.active_slots:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+
+    def test_duplicate_slot_race_single_winner(self, plane):
+        """Two claimants race one slot: exactly one wins, and the loser's
+        exit does not free the winner's claim (the identity-checked
+        release)."""
+        holder, *_rest = plane
+        gw = holder["gw"]
+        a = _client(gw, slot=3, incarnation=100, reconnect_timeout=0.5)
+        b = _client(gw, slot=3, incarnation=200)  # fences a's claim
+        assert gw.fenced == 1
+        assert gw.active_slots == {3: 200}
+        b.tick(actor_steps=2)  # winner fully functional
+        # loser's reconnect arrives with incarnation 101 < 200: refused,
+        # terminally — a live duplicate can never steal the slot back
+        with pytest.raises(ConnectionError):
+            a.tick(actor_steps=1)
+        assert a.disconnected.is_set()
+        a.close()
+        time.sleep(0.2)  # a's departure must not disturb b's claim
+        assert gw.active_slots == {3: 200}
+        b.tick(actor_steps=1)
+        b.close()
+
+    def test_soak_smoke_no_violations(self):
+        """Short randomized soak (the tools/chaos_soak.py entry point):
+        seeded wire faults + one gateway restart cycle, zero invariant
+        violations."""
+        report = soak(seconds=3.0, actors=2, seed=7, restart_every=1.2,
+                      reconnect_timeout=10.0, verbose=False)
+        assert report["violations"] == []
+        assert report["gateway_restarts"] >= 1
+        assert report["delivered_chunks"] >= report["acked_chunks"] > 0
+
+
+class _FlakyTickClient:
+    """RemoteClock satellite regression: tick raises once, then works."""
+
+    def __init__(self):
+        self.stop = threading.Event()
+        self.disconnected = threading.Event()
+        self.learner_step = 0
+        self.failures = 1
+        self.ticked = []
+
+    def tick(self, actor_steps=0, stats=None):
+        if self.failures:
+            self.failures -= 1
+            raise ConnectionError("transient")
+        self.ticked.append(actor_steps)
+        return self.learner_step
+
+
+def test_remote_clock_flush_restores_steps_on_failure():
+    client = _FlakyTickClient()
+    rclock = RemoteClock(client, flush_every=10 ** 9)
+    rclock._pending = 300
+    rclock.flush()  # fails: the 300 steps must survive
+    assert rclock._pending == 300
+    assert client.ticked == []
+    rclock.flush()  # heals: everything delivered, nothing double-counted
+    assert client.ticked == [300]
+    assert rclock._pending == 0
+
+
+class TestFleetEndToEndChaos:
+    @pytest.mark.slow
+    @pytest.mark.timeout(900)
+    def test_real_fleet_survives_gateway_restart(self, tmp_path):
+        """The full acceptance scenario on the REAL stack: thread-backend
+        learner + 2 remote actors over localhost, gateway killed and
+        rebound mid-run.  Every actor reconnects, re-claims its slot via
+        incarnation fencing, resends its unacked chunk, and the run
+        completes — no abandoned slots, no duplicate-slot epsilon skew,
+        no fake 'run complete'."""
+        from pytorch_distributed_tpu.fleet import (
+            FleetTopology, _remote_actor_main,
+        )
+
+        opt = build_options(
+            1, num_actors=2, root_dir=str(tmp_path), seed=7,
+            steps=30, learn_start=20, memory_size=512, batch_size=16,
+            actor_freq=25, actor_sync_freq=20, param_publish_freq=10,
+            learner_freq=10, evaluator_freq=1, evaluator_nepisodes=1,
+            checkpoint_freq=0, early_stop=50,
+        )
+        topo = FleetTopology(opt, local_actors=0, port=0)
+        actors = [
+            threading.Thread(
+                target=_remote_actor_main,
+                args=(opt, f"127.0.0.1:{topo.port}", ind), daemon=True)
+            for ind in range(2)
+        ]
+        for t in actors:
+            t.start()
+
+        restarted = threading.Event()
+
+        def chaos():
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                if (topo.gateway.chunks_in >= 2
+                        and not topo.clock.stop.is_set()):
+                    topo.restart_gateway()
+                    restarted.set()
+                    return
+                time.sleep(0.1)
+
+        killer = threading.Thread(target=chaos, daemon=True)
+        killer.start()
+        topo.run(backend="thread")
+        killer.join(10)
+        for t in actors:
+            t.join(30)
+            assert not t.is_alive()
+        assert restarted.is_set(), "chaos never fired; scenario not tested"
+        assert topo.clock.learner_step.value >= 30  # run COMPLETED
+        assert topo.clock.actor_step.value > 0
+        # the post-restart gateway carried the rest of the run: both
+        # actors re-attached and streamed experience through it
+        assert topo.gateway.connections >= 2
+        assert topo.gateway.chunks_in > 0
+        # clean exits free the slots — asynchronously, on the gateway's
+        # serve threads, so poll rather than assert-once
+        deadline = time.monotonic() + 10
+        while topo.gateway.active_slots:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)

@@ -127,18 +127,88 @@ class TestGateway:
         assert client.stop.is_set()
         client.close()
 
-    def test_client_stop_on_gateway_death(self, gateway):
+    def test_client_disconnects_on_gateway_death(self, gateway):
         gw, _store, _clock, _stats, _chunks = gateway
-        client = DcnClient(("127.0.0.1", gw.port))
+        client = DcnClient(("127.0.0.1", gw.port), heartbeat_interval=0,
+                           reconnect_timeout=1.0)
         rclock = RemoteClock(client, flush_every=1)
         gw.close()
-        # the next flush hits a dead socket: stop must trip, not hang
-        deadline = time.monotonic() + 10
-        while not client.stop.is_set():
+        # the next flush hits a dead socket and no gateway ever returns:
+        # the reconnect budget burns out into the DISCONNECTED state —
+        # never the stop flag, which is reserved for "learner said stop"
+        # (a gateway blip must not read as a completed run)
+        deadline = time.monotonic() + 30
+        while not client.disconnected.is_set():
             rclock.add_actor_steps(1)
             assert time.monotonic() < deadline
             time.sleep(0.05)
+        assert not client.stop.is_set()
         assert rclock.done(steps=10 ** 9)
+        assert rclock._pending >= 1  # failed ticks re-queued, not dropped
+
+
+class TestSlotLifecycle:
+    def test_slot_freed_on_disconnect_then_reclaimable(self, gateway):
+        gw, *_ = gateway
+        c1 = DcnClient(("127.0.0.1", gw.port), process_ind=5,
+                       heartbeat_interval=0)
+        assert gw.active_slots == {5: c1.incarnation}
+        c1.close()
+        deadline = time.monotonic() + 5
+        while gw.active_slots:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        c2 = DcnClient(("127.0.0.1", gw.port), process_ind=5,
+                       heartbeat_interval=0)
+        assert gw.active_slots == {5: c2.incarnation}
+        c2.close()
+
+    def test_hello_conflict_on_live_duplicate(self, gateway):
+        gw, *_ = gateway
+        c1 = DcnClient(("127.0.0.1", gw.port), process_ind=3,
+                       incarnation=200, heartbeat_interval=0)
+        # equal (or lower) incarnation = a genuine duplicate actor, the
+        # epsilon-schedule-skewing config error: refused outright
+        with pytest.raises(RuntimeError, match="already connected"):
+            DcnClient(("127.0.0.1", gw.port), process_ind=3,
+                      incarnation=200, heartbeat_interval=0)
+        assert gw.active_slots == {3: 200}  # original claim untouched
+        assert gw.fenced == 0
+        c1.tick(actor_steps=1)  # and still live
+        c1.close()
+
+    def test_fencing_evicts_lower_incarnation_predecessor(self, gateway):
+        gw, *_ = gateway
+        a = DcnClient(("127.0.0.1", gw.port), process_ind=3,
+                      incarnation=100, heartbeat_interval=0,
+                      reconnect_timeout=1.0)
+        b = DcnClient(("127.0.0.1", gw.port), process_ind=3,
+                      incarnation=200, heartbeat_interval=0)
+        assert gw.fenced == 1
+        assert gw.active_slots == {3: 200}
+        b.tick(actor_steps=1)  # the higher incarnation owns the slot
+        # the fenced-off predecessor cannot reclaim: its reconnect
+        # arrives at incarnation 101 < 200 and is terminally refused
+        with pytest.raises(ConnectionError):
+            a.tick(actor_steps=1)
+        assert a.disconnected.is_set() and not a.stop.is_set()
+        a.close()
+        time.sleep(0.2)
+        assert gw.active_slots == {3: 200}  # identity-checked release
+        b.close()
+
+    def test_local_slot_refused(self):
+        clock = GlobalClock()
+        gw = DcnGateway(ParamStore(16), clock, ActorStats(),
+                        put_chunk=lambda items: None,
+                        host="127.0.0.1", port=0, local_actors=2)
+        try:
+            with pytest.raises(RuntimeError,
+                               match="local to the learner host"):
+                DcnClient(("127.0.0.1", gw.port), process_ind=1,
+                          heartbeat_interval=0)
+        finally:
+            gw.close()
 
 
 class TestFleetEndToEnd:

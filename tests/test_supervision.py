@@ -40,11 +40,24 @@ def test_backoff_grows_and_caps():
     assert delays[4] == 30.0 and delays[5] == 30.0
 
 
-def test_unborn_slot_starts_fresh():
-    # a slot never marked born reads as an ancient incarnation: the first
-    # crash resets its budget then grants (the runtime monitor starts with
-    # no recorded births and must still restart a crashed actor)
+def test_unborn_slot_grants_without_reset():
+    # a slot with no recorded birth still gets restarts (a supervisor may
+    # observe a crash before its first note_birth) — but from the normal
+    # budget, not via the grace-period reset
     b = RestartBudget(max_restarts=1)
     assert b.request_restart(7) == 0.0
     b.note_birth(7)  # callers record the respawn; a young crash then burns
     assert b.request_restart(7) is None
+
+
+def test_unborn_slot_does_not_refill_budget():
+    # regression: _born.get(slot, 0.0) made every unborn slot look like
+    # an ancient incarnation, so each crash reset the count to zero and
+    # the budget refilled forever — a crash-looping worker whose
+    # supervisor never called note_birth was restarted without bound
+    b = RestartBudget(max_restarts=2, grace=0.0)  # grace=0: any RECORDED
+    # birth would reset; the unborn slot must not
+    assert b.request_restart(5) == 0.0
+    assert b.request_restart(5) == 0.0
+    assert b.request_restart(5) is None
+    assert b.count(5) == 2

@@ -1,0 +1,282 @@
+"""Randomized fault-injection soak for the DCN session layer.
+
+Runs a learner-plane simulation (gateway + clock/param/stat fixtures, no
+jax) with N synthetic remote actors hammering every client surface, while
+a seeded orchestrator restarts the gateway and the per-client
+FaultInjectors (utils/faults.py random mode) sever/delay/corrupt the
+wire.  Exits nonzero on any invariant violation:
+
+- **lost slot** — an actor ends "disconnected" (or never ends) even
+  though the gateway was only ever down for less than the reconnect
+  budget;
+- **duplicate slot** — a slot observed outside the expected range, or a
+  slot whose incarnation moved backwards (two live claimants);
+- **learner-step regression** — a client observes the learner clock run
+  backwards (the tell for answering a stale/ghost gateway);
+- **lost experience** — a chunk the wire acknowledged that never reached
+  ``put_chunk`` (duplicates are legal — delivery is at-least-once — loss
+  is not).
+
+Usage:
+    python tools/chaos_soak.py --seconds 30 --actors 4 --seed 0
+    python tools/chaos_soak.py --seconds 60 --restart-every 5
+
+The same ``SyntheticActor`` drives the deterministic chaos scenarios in
+tests/test_chaos.py; this entry point is the long-haul randomized
+version (satellite of the fault-tolerant session layer, parallel/dcn.py
+failure model).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+from pytorch_distributed_tpu.parallel.dcn import (
+    DcnClient, DcnGateway, RemoteClock, RemoteParamStore, RemoteStats,
+)
+from pytorch_distributed_tpu.utils.experience import Transition
+from pytorch_distributed_tpu.utils.faults import FaultInjector
+
+
+def tagged_transition(tag: int) -> Transition:
+    """A minimal transition whose reward carries a chunk-traceable id."""
+    z = np.zeros(2, dtype=np.float32)
+    return Transition(state0=z, action=np.int32(0),
+                      reward=np.float32(tag), gamma_n=np.float32(0.99),
+                      state1=z, terminal1=np.float32(0.0))
+
+
+class ChunkLog:
+    """Gateway-side ``put_chunk`` sink: records the id tag of every
+    delivered transition (thread-safe — serve threads race into it)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tags: List[int] = []
+
+    def __call__(self, items: list) -> None:
+        with self._lock:
+            self.tags.extend(int(t.reward) for t, _p in items)
+
+    def seen(self) -> Dict[int, int]:
+        with self._lock:
+            out: Dict[int, int] = {}
+            for tag in self.tags:
+                out[tag] = out.get(tag, 0) + 1
+            return out
+
+
+class SyntheticActor:
+    """Drives every client surface of the session layer — experience
+    chunks, clock ticks, stat pushes, param fetches — without envs, jax,
+    or a real learner, so chaos drills run in milliseconds.  Records
+    which chunk tags the wire ACKNOWLEDGED (the at-least-once delivery
+    set the gateway must cover) and how the loop ended."""
+
+    def __init__(self, address, slot: int, steps: int = 10 ** 9,
+                 client_kwargs: Optional[dict] = None, pace: float = 0.0):
+        self.address = address
+        self.slot = slot
+        self.steps = steps
+        self.pace = pace
+        self.client_kwargs = client_kwargs or {}
+        self.client: Optional[DcnClient] = None
+        self.acked_tags: List[int] = []
+        self.step_regressions = 0
+        self.outcome: Optional[str] = None  # "stopped"|"disconnected"|err
+        self.thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SyntheticActor":
+        self.thread = threading.Thread(
+            target=self.run, name=f"chaos-actor-{self.slot}", daemon=True)
+        self.thread.start()
+        return self
+
+    def run(self) -> None:
+        try:
+            self.client = client = DcnClient(
+                self.address, process_ind=self.slot, **self.client_kwargs)
+        except Exception as e:  # refused HELLO / dead gateway
+            self.outcome = f"connect-failed: {e!r}"
+            return
+        rclock = RemoteClock(client, flush_every=16, max_age=0.5)
+        rstats = RemoteStats(client)
+        rparams = RemoteParamStore(client)
+        i = 0
+        last_step = -1
+        try:
+            while not rclock.done(self.steps):
+                tag = (self.slot << 20) | i
+                client.send_chunk(
+                    [(tagged_transition(tag), None)])  # acked iff returns
+                self.acked_tags.append(tag)
+                rclock.add_actor_steps(1)
+                if i % 8 == 0:
+                    rparams.fetch(0)
+                if i % 16 == 0:
+                    rstats.add(nepisodes=1.0, total_reward=1.0)
+                step = client.learner_step
+                if step < last_step:
+                    self.step_regressions += 1
+                last_step = step
+                i += 1
+                if self.pace:
+                    time.sleep(self.pace)
+        except (ConnectionError, OSError):
+            pass  # terminal loss: outcome read from the latched events
+        except Exception as e:
+            self.outcome = f"crashed: {e!r}"
+            client.close()
+            return
+        try:
+            rclock.flush()
+        except (ConnectionError, OSError):
+            pass
+        client.close()
+        self.outcome = ("disconnected"
+                        if client.disconnected.is_set()
+                        and not client.stop.is_set() else "stopped")
+
+
+def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
+         restart_every: Optional[float] = 5.0,
+         fault_rates: Optional[Dict[str, float]] = None,
+         reconnect_timeout: float = 10.0,
+         verbose: bool = True) -> dict:
+    """Run the randomized soak; returns a report dict whose
+    ``violations`` list is empty on a healthy session layer."""
+    rng = np.random.default_rng(seed)
+    clock = GlobalClock()
+    stats = ActorStats()
+    store = ParamStore(8)
+    store.publish(np.zeros(8, dtype=np.float32))
+    log = ChunkLog()
+    gw = DcnGateway(store, clock, stats, put_chunk=log,
+                    host="127.0.0.1", port=0, idle_deadline=30.0)
+    port = gw.port
+    violations: List[str] = []
+    fenced = 0
+    gateway_restarts = 0
+
+    fleet = [
+        SyntheticActor(
+            ("127.0.0.1", port), slot=i, pace=0.002,
+            client_kwargs=dict(
+                reconnect_timeout=reconnect_timeout,
+                heartbeat_interval=0.5,
+                faults=FaultInjector.random(
+                    seed * 1000 + i,
+                    rates=fault_rates, name=f"actor-{i}"),
+            )).start()
+        for i in range(actors)
+    ]
+
+    deadline = time.monotonic() + seconds
+    next_restart = (time.monotonic() + restart_every
+                    if restart_every else float("inf"))
+    incarnation_high: Dict[int, int] = {}
+    learner_step = 0
+    while time.monotonic() < deadline:
+        time.sleep(0.1)
+        learner_step += 5  # the simulated learner's clock
+        clock.set_learner_step(learner_step)
+        if learner_step % 50 == 0:
+            store.publish(np.full(8, learner_step, dtype=np.float32))
+        # invariant: slots in range, incarnations never move backwards
+        for slot, inc in gw.active_slots.items():
+            if not (0 <= slot < actors):
+                violations.append(f"unexpected slot {slot} active")
+            if inc < incarnation_high.get(slot, 0):
+                violations.append(
+                    f"slot {slot} incarnation regressed "
+                    f"{incarnation_high[slot]} -> {inc}")
+            incarnation_high[slot] = max(
+                inc, incarnation_high.get(slot, 0))
+        if time.monotonic() >= next_restart:
+            fenced += gw.fenced
+            gw.close()
+            gateway_restarts += 1
+            gw = DcnGateway(store, clock, stats, put_chunk=log,
+                            host="127.0.0.1", port=port,
+                            idle_deadline=30.0)
+            next_restart = (time.monotonic() + restart_every
+                            * (0.5 + float(rng.random())))
+
+    clock.stop.set()  # next reply any client sees carries stop:true
+    for a in fleet:
+        a.thread.join(reconnect_timeout + 15.0)
+        if a.thread.is_alive():
+            violations.append(f"actor {a.slot} failed to stop (lost slot)")
+        elif a.outcome != "stopped":
+            violations.append(f"actor {a.slot} ended {a.outcome!r} "
+                              f"(lost slot)")
+        if a.step_regressions:
+            violations.append(f"actor {a.slot} saw the learner clock "
+                              f"regress {a.step_regressions}x")
+    fenced += gw.fenced
+    gw.close()
+
+    seen = log.seen()
+    acked = [t for a in fleet for t in a.acked_tags]
+    lost = [t for t in acked if t not in seen]
+    if lost:
+        violations.append(f"{len(lost)} acked chunks never delivered "
+                          f"(first: {lost[:5]})")
+    report = {
+        "violations": violations,
+        "actors": actors,
+        "acked_chunks": len(acked),
+        "delivered_chunks": len(log.tags),
+        "duplicate_deliveries": len(log.tags) - len(seen),
+        "reconnects": sum(a.client.reconnects for a in fleet if a.client),
+        "injected_faults": sum(
+            a.client_kwargs["faults"].injected for a in fleet),
+        "gateway_restarts": gateway_restarts,
+        "fenced": fenced,
+        "final_learner_step": learner_step,
+    }
+    if verbose:
+        for k, v in report.items():
+            if k != "violations":
+                print(f"[chaos] {k}: {v}")
+        for v in violations:
+            print(f"[chaos] VIOLATION: {v}")
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/chaos_soak.py",
+        description="randomized fault-injection soak for the DCN "
+                    "session layer (exits nonzero on invariant "
+                    "violations)")
+    ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--actors", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--restart-every", type=float, default=5.0,
+                    help="mean seconds between gateway kill+rebinds "
+                         "(0 disables)")
+    ap.add_argument("--reconnect-timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    report = soak(seconds=args.seconds, actors=args.actors, seed=args.seed,
+                  restart_every=args.restart_every or None,
+                  reconnect_timeout=args.reconnect_timeout)
+    ok = not report["violations"]
+    print(f"[chaos] {'OK' if ok else 'FAILED'} after {args.seconds:.0f}s: "
+          f"{len(report['violations'])} violations")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
