@@ -41,6 +41,12 @@ def parse_args(argv=None):
                    help="learner steps between param publications")
     p.add_argument("--model-file", type=str, default=None,
                    help="finetune (mode 1) / test (mode 2) checkpoint")
+    p.add_argument("--resume", type=str, default=None, metavar="REFS",
+                   help="resume run REFS from its newest complete "
+                        "checkpoint epoch (models/REFS_ckpt): train "
+                        "state, replay, clock counters, best-score and "
+                        "RNG continue; fails fast if no complete epoch "
+                        "or legacy snapshot exists")
     p.add_argument("--backend", choices=("process", "thread"),
                    default="process")
     p.add_argument("--no-tensorboard", action="store_true")
@@ -80,6 +86,9 @@ def options_from_args(args):
         overrides["param_publish_freq"] = args.publish_freq
     if args.model_file is not None:
         overrides["model_file"] = args.model_file
+    if args.resume is not None:
+        overrides["refs"] = args.resume
+        overrides["resume"] = "must"
     if args.no_tensorboard:
         overrides["visualize"] = False
     if args.render:

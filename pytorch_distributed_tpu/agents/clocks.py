@@ -24,6 +24,13 @@ class GlobalClock:
     def __init__(self):
         self.actor_step = _CTX.Value("l", 0, lock=True)
         self.learner_step = _CTX.Value("l", 0, lock=True)
+        # Best evaluator reward so far — shared so (a) the learner can bind
+        # it into every checkpoint epoch (utils/checkpoint.py save_epoch
+        # extras) and (b) a resumed run's evaluator can't clobber
+        # ``<refs>_best.msgpack`` with a worse policy: the learner restores
+        # this from the epoch before its first publication, ahead of any
+        # eval (agents/evaluator.py reads it per comparison).
+        self.best_eval_reward = _CTX.Value("d", float("-inf"), lock=True)
         # Cooperative shutdown — the supervision layer the reference lacks
         # (SURVEY.md §5 "failure detection: none"): a dead learner there
         # stalls the clock and every loop spins forever; here the runtime
@@ -34,6 +41,14 @@ class GlobalClock:
         with self.actor_step.get_lock():
             self.actor_step.value += n
             return self.actor_step.value
+
+    def seed_actor_steps(self, n: int) -> None:
+        """Additive restore of a checkpointed actor-step count: actors may
+        already be stepping when the learner restores the epoch, so the
+        baseline is ADDED under the lock rather than overwriting their
+        early increments."""
+        with self.actor_step.get_lock():
+            self.actor_step.value += n
 
     def set_learner_step(self, value: int) -> None:
         with self.learner_step.get_lock():

@@ -121,7 +121,14 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         opt.seed, "evaluator"))
     _, unravel = make_flattener(params0)
 
-    best_reward = float("-inf")
+    # best-so-far lives on the shared clock, not a process-local: the
+    # learner binds it into every checkpoint epoch and restores it before
+    # its first publication (agents/learner.py), so a resumed run's dips
+    # can never overwrite <refs>_best.msgpack with a worse policy than
+    # the pre-crash best (the reference has no best tier at all)
+    if clock.best_eval_reward.value > float("-inf"):
+        print(f"[evaluator] best-so-far restored: "
+              f"{clock.best_eval_reward.value:g}")
 
     # ---- capture thread: cadence-true weight snapshots -------------------
     # (flat, learner_step, wall) tuples, oldest first.  MAX_BACKLOG bounds
@@ -162,7 +169,6 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     cap_thread.start()
 
     def evaluate(flat: np.ndarray, at_step: int, at_wall: float) -> None:
-        nonlocal best_reward
         # host-side inference: unravel straight onto the CPU device
         # (actors do the same; see utils/helpers.py pin_to_cpu)
         params = unravel_on_cpu(unravel, flat)
@@ -190,9 +196,19 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         # DQN evals can transiently collapse right after a peak — and the
         # latest-params tier alone would let a run that ends mid-dip
         # overwrite its own best policy.  <refs>_best.msgpack always
-        # holds the weights of the highest eval so far.
-        if avg_reward > best_reward:
-            best_reward = avg_reward
+        # holds the weights of the highest eval so far — ACROSS resumes,
+        # via the clock-shared score the checkpoint epochs persist.
+        with clock.best_eval_reward.get_lock():
+            is_best = avg_reward > clock.best_eval_reward.value
+            if is_best:
+                clock.best_eval_reward.value = avg_reward
+        if is_best:
+            # sidecar BEFORE the weights: a crash between the two writes
+            # then leaves the score ahead of the file — a conservative
+            # threshold that can only delay the next best-write, never
+            # let a worse policy overwrite a better one (the reverse
+            # order would; checkpoint.py save_best_score docstring)
+            ckpt.save_best_score(opt.model_name, avg_reward, step=at_step)
             ckpt.save_params(
                 ckpt.params_path(opt.model_name + "_best"), params)
 

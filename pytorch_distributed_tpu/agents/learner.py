@@ -125,11 +125,44 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                              state_shardings=state_shardings)
     state = learner.place(state)
 
-    # resume full state if a prior run left one (the resume tier the
-    # reference lacks, utils/checkpoint.py docstring)
-    restored = ckpt.restore_train_state(opt.model_name, jax.device_get(state))
-    if restored is not None:
-        state = learner.place(restored)
+    # ---- resume: newest complete checkpoint epoch, else the legacy
+    # single snapshot (utils/checkpoint.py docstring).  Epoch extras
+    # (clock counters, evaluator best-score) restore BEFORE the first
+    # publication so no worker ever observes pre-resume values.
+    assert opt.resume in ("auto", "must", "never"), (
+        f"unknown resume mode {opt.resume!r}")
+    epoch = None
+    if opt.resume != "never":
+        epoch = ckpt.resolve_epoch(opt.model_name)
+        if epoch is not None:
+            state = learner.place(
+                ckpt.load_epoch_state(epoch, jax.device_get(state)))
+            clock.seed_actor_steps(int(epoch.extras.get("actor_step", 0)))
+            # the sidecar (written WITH every best-params file) can be
+            # ahead of the epoch's score when the record fell between
+            # two commits — take the max so a resumed run never lets a
+            # worse policy overwrite <refs>_best.msgpack
+            best = max(float(epoch.extras.get("best_eval_reward",
+                                              float("-inf"))),
+                       ckpt.load_best_score(opt.model_name))
+            clock.best_eval_reward.value = best
+            print(f"[learner] resumed epoch {epoch.epoch} "
+                  f"(step {epoch.learner_step}, "
+                  f"actor_step +{int(epoch.extras.get('actor_step', 0))}, "
+                  f"best_eval {best:g})")
+        else:
+            restored = ckpt.restore_train_state(opt.model_name,
+                                                jax.device_get(state))
+            if restored is not None:
+                state = learner.place(restored)
+                clock.best_eval_reward.value = ckpt.load_best_score(
+                    opt.model_name)
+                print("[learner] resumed legacy single-snapshot state")
+            elif opt.resume == "must":
+                raise RuntimeError(
+                    f"resume='must' but no complete checkpoint epoch "
+                    f"under {ckpt.ckpt_root(opt.model_name)} and no "
+                    f"legacy snapshot at {ckpt.state_dir(opt.model_name)}")
 
     # ---- initial publication: actors block on version 1 ----
     def _publish(st) -> None:
@@ -253,22 +286,50 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
 
         device_key = jax.random.PRNGKey(
             np_rng(opt.seed, "learner", process_ind).integers(2 ** 31))
+        saved_key = (epoch.extras.get("rng", {}).get("learner_device")
+                     if epoch is not None else None)
+        if saved_key:
+            # resume the device sampling stream where the epoch froze it
+            # (keys pre-split after the save are re-drawn — a bounded
+            # overlap, not a reuse of the whole stream)
+            device_key = ckpt.deserialize_prng_key(saved_key, device_key)
         key_buf: list = []  # pre-split sampling keys, one split per 64
         # the CPU backend's collective rendezvous needs per-step blocking
         # (see ShardedLearner.step)
         block_each_step = (mesh is not None
                            and mesh.devices.flat[0].platform == "cpu")
 
-    # warm-start the replay from a prior run's snapshot (after attach, so
-    # device rings land in HBM) — with the train state restored above this
-    # makes resume complete: params/opt/step AND the experience
-    if opt.memory_params.checkpoint_replay:
+    # warm-start the replay from the SAME epoch the train state came from
+    # (after attach, so device rings land in HBM) — state, replay and
+    # counters are one digest-verified triple, never a mixed resume.  A
+    # geometry change between runs fails loudly here (CheckpointMismatch)
+    # instead of as a broadcast error deep in the first train step.
+    if epoch is not None and opt.memory_params.checkpoint_replay:
+        # the flag gates the restore leg exactly like the save leg (and
+        # the legacy branch below): a user resuming with
+        # checkpoint_replay=false has asked for a cold replay — e.g.
+        # after a deliberate memory-geometry change — and must not trip
+        # CheckpointMismatch on an artifact they opted out of
+        rows = ckpt.load_epoch_replay(epoch, memory)
+        if rows:
+            print(f"[learner] replay restored from epoch {epoch.epoch}: "
+                  f"{rows} rows")
+    elif epoch is None and opt.memory_params.checkpoint_replay:
         if ckpt.load_replay(opt.model_name, memory):
             print(f"[learner] replay restored: {memory_size(memory)} rows")
 
     rng = np_rng(opt.seed, "learner", process_ind)
     lstep = int(jax.device_get(state.step))
     lstep0 = lstep  # checkpoint-resumed steps; pacing baselines on THIS run
+    if epoch is not None:
+        # the epoch binds the pacing baseline and host RNG to the counters
+        # restored above: replay-ratio throttling continues on cumulative
+        # (lstep - lstep0) vs the restored actor clock instead of
+        # resetting every resume (and the sampling stream continues
+        # instead of replaying itself)
+        lstep0 = int(epoch.extras.get("lstep0", lstep0))
+        ckpt.restore_np_rng(
+            rng, epoch.extras.get("rng", {}).get("learner_host"))
     clock.set_learner_step(lstep)
 
     # ---- gate until the replay warms up (reference dqn_learner.py:51) ----
@@ -293,6 +354,29 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     # per-phase timings go straight to the run's JSONL stream (appends are
     # atomic line writes; the logger process keeps the aggregated scalars)
     timing_writer = MetricsWriter(opt.log_dir, enable_tensorboard=False)
+
+    def _save_epoch() -> None:
+        """One coordinated checkpoint epoch: train state + replay +
+        clocks/counters/best-score/RNG, captured NOW and committed by the
+        atomic manifest rename (utils/checkpoint.py save_epoch) — the
+        crash-consistent replacement for the old separate
+        save_train_state/save_replay writes."""
+        extras = dict(
+            learner_step=lstep,
+            lstep0=lstep0,
+            actor_step=int(clock.actor_step.value),
+            best_eval_reward=float(clock.best_eval_reward.value),
+            replay_size=int(getattr(memory, "size", 0)),
+            rng=dict(
+                learner_host=ckpt.serialize_np_rng(rng),
+                learner_device=(ckpt.serialize_prng_key(device_key)
+                                if on_device else None),
+            ),
+        )
+        ckpt.save_epoch(
+            opt.model_name, state=state,
+            memory=memory if opt.memory_params.checkpoint_replay else None,
+            extras=extras, retain=ap.checkpoint_retain)
 
     while lstep < ap.steps and not clock.stop.is_set() \
             and time.monotonic() < deadline:
@@ -360,7 +444,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             with timer.phase("publish"):
                 _publish_async(state)
         if crossed(ap.checkpoint_freq):
-            ckpt.save_train_state(opt.model_name, state)
+            _save_epoch()
 
         if crossed(ap.learner_freq):  # reference dqn_learner.py:99-101
             now = time.monotonic()
@@ -382,17 +466,16 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             t_cadence = now
             last_stats_lstep = lstep
 
-    # final publication + full-state checkpoint so a next run can resume
+    # final publication + final checkpoint epoch so a next run can resume
+    # — this is also the preemption path: a SIGTERM (runtime.py) trips
+    # clock.stop, the loop above drains out, and the run's last complete
+    # state is committed here before exit
     if _pub_thread is not None:
         _pub_stop.set()
         _pub_event.set()
         _pub_thread.join(timeout=120)
     _publish(state)
-    ckpt.save_train_state(opt.model_name, state)
-    if opt.memory_params.checkpoint_replay:
-        # final only (replay snapshots are large); the cadence
-        # checkpoints cover the train state
-        ckpt.save_replay(opt.model_name, memory)
+    _save_epoch()
     timing_writer.close()
 
 

@@ -200,7 +200,17 @@ class AgentParams:
     # equivalent: there weight visibility is implicit shared-CUDA and only
     # the evaluator checkpoints) ---
     param_publish_freq: int = 10       # learner steps between ParamStore publishes
-    checkpoint_freq: int = 0           # learner steps between full-state Orbax saves (0 = final only)
+    # Checkpoint-epoch cadence: learner steps between coordinated epoch
+    # saves (train state + replay when checkpoint_replay + clocks/RNG,
+    # committed atomically — utils/checkpoint.py save_epoch).  0 = final
+    # epoch only.  With checkpoint_replay on, EVERY epoch carries the
+    # replay contents (the crash-consistency point of the subsystem), so
+    # size the cadence to what the replay serialization costs.
+    checkpoint_freq: int = 0
+    # Committed epochs kept on disk; older ones are garbage-collected
+    # after each successful commit (the newest complete epoch is never
+    # collected).
+    checkpoint_retain: int = 3
     # --- off-policy core (reference :134-137 / :163-166) ---
     learn_start: int = 5000            # ddpg: 250
     batch_size: int = 128              # ddpg: 64
@@ -329,6 +339,16 @@ class Options:
     num_actors: int = 8
     num_learners: int = 1
     model_file: Optional[str] = None   # finetune/test source checkpoint
+    # Resume mode for the checkpoint-epoch tier (utils/checkpoint.py):
+    #   "auto"  — resume from the newest complete epoch under
+    #             ``{model_name}_ckpt`` if one exists (falling back to the
+    #             legacy ``_state`` snapshot), else start fresh;
+    #   "must"  — refuse to start without a resumable checkpoint (what
+    #             ``--resume REFS`` sets: a preempted run restarted by an
+    #             orchestrator must never silently train from scratch);
+    #   "never" — ignore existing checkpoints (fresh run even if the refs
+    #             collide with an old one's).
+    resume: str = "auto"
     visualize: bool = True
 
     agent_type: str = "dqn"

@@ -62,7 +62,9 @@ from __future__ import annotations
 import argparse
 import multiprocessing as mp
 import os
+import signal
 import sys
+import threading
 import time
 from typing import List, Optional
 
@@ -233,8 +235,6 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
                              args=(opt, coordinator, ind),
                              name=f"fleet-actor-{ind}", daemon=True)
         else:
-            import threading
-
             def _thread_main(ind=ind):
                 from pytorch_distributed_tpu.utils.supervision import (
                     EXIT_CRASH,
@@ -280,9 +280,22 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
     budget = RestartBudget(max_restarts=max_restarts, backoff=True)
     for ind in workers:
         budget.note_birth(ind)
+    # SIGTERM = the host is being preempted: actor hosts hold no
+    # checkpointable state (the learner host owns the epoch store), so
+    # the right drain is to stop respawning and terminate the rollout
+    # workers promptly — their unflushed chunks are the bounded loss the
+    # failure model already declares (parallel/dcn.py "Lost").
+    host_stop = threading.Event()
+    prev_term = None
+    if threading.current_thread() is threading.main_thread():
+        try:
+            prev_term = signal.signal(
+                signal.SIGTERM, lambda s, f: host_stop.set())
+        except (ValueError, OSError):  # pragma: no cover
+            prev_term = None
     pending: dict = {}  # slot -> respawn-at deadline (crash backoff)
     abandoned: List[int] = []
-    while workers or pending:
+    while (workers or pending) and not host_stop.is_set():
         time.sleep(0.5)
         now = time.monotonic()
         for ind, at in list(pending.items()):
@@ -324,6 +337,16 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
             workers.clear()
             pending.clear()
             break
+    if host_stop.is_set():
+        print(f"[fleet] SIGTERM: preemption notice — terminating "
+              f"{len(workers)} actors on this host")
+        for ind, w in list(workers.items()):
+            w.terminate()
+            w.join(10.0)
+        workers.clear()
+        pending.clear()
+    if prev_term is not None:
+        signal.signal(signal.SIGTERM, prev_term)
     return abandoned
 
 
@@ -349,6 +372,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--actor-count", type=int, default=8,
                     help="[actors] actors to run on this host")
     ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--resume", type=str, default=None, metavar="REFS",
+                    help="[learner] resume run REFS from its newest "
+                         "complete checkpoint epoch (models/REFS_ckpt — "
+                         "written on the checkpoint_freq cadence and on "
+                         "SIGTERM preemption); fails fast if none exists. "
+                         "Remote actor hosts need no flag: their slots "
+                         "re-attach through the DCN session layer's "
+                         "incarnation fencing as on any learner restart.")
     ap.add_argument("--set", action="append", default=[], metavar="K=V",
                     help="Options override, e.g. --set steps=2000 "
                          "--set batch_size=32 (repeatable; int/float/str "
@@ -385,6 +416,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         overrides["num_actors"] = args.num_actors
     if args.seed is not None:
         overrides["seed"] = args.seed
+    if args.resume is not None:
+        if args.role != "learner":
+            ap.error("--resume applies to the learner host (actor hosts "
+                     "re-attach through DCN incarnation fencing)")
+        overrides["refs"] = args.resume
+        overrides["resume"] = "must"
     opt = build_options(args.config, **overrides)
 
     if args.role == "learner":

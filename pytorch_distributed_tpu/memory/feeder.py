@@ -129,7 +129,8 @@ class QueueOwner:
 
     def snapshot(self) -> dict:
         if not hasattr(self.memory, "snapshot"):
-            # e.g. SequenceReplay: checkpoint.save_replay skips cleanly
+            # snapshot-less wrapped memory: checkpoint.save_replay skips
+            # cleanly instead of crashing the learner
             raise NotImplementedError(type(self.memory).__name__)
         while self.drain():  # a deep backlog needs multiple capped drains
             pass

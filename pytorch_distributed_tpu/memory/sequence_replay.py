@@ -194,6 +194,7 @@ class SequenceReplay:
                  pack_frames: int = 0):
         self.capacity = capacity
         self.T = seq_len
+        self.lstm_dim = lstm_dim
         self.alpha = priority_exponent
         self.beta0 = importance_weight
         self.beta_steps = importance_anneal_steps
@@ -280,3 +281,52 @@ class SequenceReplay:
         self.priority[np.asarray(indices)] = pr
         if pr.size:
             self.max_priority = max(self.max_priority, float(pr.max()))
+
+    # -- checkpoint (utils/checkpoint.py save_replay/load_replay) -----------
+
+    _FIELDS = ("obs", "action", "reward", "terminal", "mask", "c0", "h0")
+
+    def snapshot(self) -> dict:
+        """Valid rows in AGE order (oldest first) + the priority leaves —
+        the same keys and units as the HBM segment ring
+        (memory/device_sequence.py snapshot), so host and device sequence
+        planes restore each other's checkpoints: leaves pre-exponentiated
+        p^alpha, running max in the shared UNexponentiated base unit."""
+        n = self.size
+        shift = -self.pos if self.full else 0
+        out = {k: np.roll(getattr(self, k), shift, axis=0)[:n].copy()
+               for k in self._FIELDS}
+        out["leaf_priority"] = np.roll(self.priority, shift)[:n].copy()
+        out["max_priority_base"] = np.float64(
+            self.max_priority ** (1.0 / self.alpha) if self.alpha
+            else self.max_priority)
+        # the exponent the leaves were saved under, so a restoring run
+        # with a different alpha converts instead of mixing units (same
+        # convention as memory/prioritized.py)
+        out["alpha"] = np.float64(self.alpha)
+        out["samples_drawn"] = np.int64(self.samples_drawn)
+        return out
+
+    def restore(self, data: dict) -> int:
+        """Refill from a snapshot (keeps the newest rows that fit);
+        returns rows restored."""
+        rows = np.asarray(data["reward"])
+        n = min(len(rows), self.capacity)
+        for k in self._FIELDS:
+            getattr(self, k)[:n] = data[k][-n:]
+        if "leaf_priority" in data:
+            leaves = np.asarray(data["leaf_priority"], np.float64)[-n:]
+            saved_alpha = float(data.get("alpha", self.alpha))
+            if saved_alpha != self.alpha and saved_alpha > 0:
+                leaves = leaves ** (self.alpha / saved_alpha)
+        else:  # priority-less source: everything replays at least once
+            leaves = np.full(n, self.max_priority, np.float64)
+        self.priority[:n] = leaves
+        # rows beyond the restored region must never be drawn (0 = empty)
+        self.priority[n:] = 0.0
+        self.pos = n % self.capacity
+        self.full = n == self.capacity
+        base = float(data.get("max_priority_base", 1.0))
+        self.max_priority = base ** self.alpha if self.alpha else base
+        self.samples_drawn = int(data.get("samples_drawn", 0))
+        return n

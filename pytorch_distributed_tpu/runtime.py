@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import signal
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -94,6 +95,9 @@ class Topology:
         self.param_store = ParamStore(_count_params(opt, self.spec))
         self.handles = build_memory(opt, self.spec)
         self._workers: List[Any] = []
+        # set when a SIGTERM (preemption notice) ended the run rather
+        # than the step budget — observable by callers/tests
+        self.preempted = threading.Event()
 
     # -- worker table (reference main.py:58-106 spawn loops) ----------------
 
@@ -124,10 +128,54 @@ class Topology:
 
     def run(self, backend: str = "process") -> None:
         """Mode-1 training (reference main.py:34-106): start workers, run
-        the learner here, supervise, join."""
+        the learner here, supervise, join.
+
+        SIGTERM is treated as a PREEMPTION NOTICE (what a TPU/VM
+        scheduler sends before reclaiming the host, Podracer-style): trip
+        the stop event so every loop drains, let the learner write its
+        final checkpoint epoch (agents/learner.py end-of-loop
+        ``_save_epoch``), join, and exit cleanly — the next ``--resume``
+        run continues from that epoch.  Installed only when this is the
+        process's main thread (signal API constraint); thread-backend
+        test harnesses driving run() from a worker thread keep their
+        default handling."""
         assert backend in ("process", "thread")
         opt = self.opt
         prebuild_native(opt)  # once, before N workers race the same g++
+        prev_term = None
+        run_over = threading.Event()
+        if threading.current_thread() is threading.main_thread():
+            def _on_sigterm(signum, frame):
+                # handler touches ONLY self.preempted (a threading.Event
+                # whose lock no other thread's hot path takes — its
+                # is_set is a lockless flag read).  Promoting to the
+                # shared mp stop event happens on the watcher thread
+                # below, never here: mp.Event's internal lock is not
+                # reentrant and the interrupted main thread — the learner
+                # — polls clock.stop constantly, so a set() from the
+                # handler could deadlock against the very loop it is
+                # trying to stop.
+                self.preempted.set()
+
+            installed = False
+            try:
+                prev_term = signal.signal(signal.SIGTERM, _on_sigterm)
+                installed = True
+            except (ValueError, OSError):  # pragma: no cover - exotic host
+                prev_term = None
+            if installed:
+                def _promote_preemption():
+                    while not run_over.is_set():
+                        if self.preempted.wait(0.2):
+                            print("[runtime] SIGTERM: preemption notice "
+                                  "— draining for a final checkpoint "
+                                  "epoch", flush=True)
+                            self.clock.stop.set()
+                            return
+
+                threading.Thread(target=_promote_preemption,
+                                 name="preempt-watch",
+                                 daemon=True).start()
         if backend == "thread":
             self._use_thread_queue()
         if backend == "process":
@@ -151,6 +199,9 @@ class Topology:
         finally:
             # learner done (or dead): release every spinning loop
             self.clock.stop.set()
+            run_over.set()  # parks the preemption watcher
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
             self._join_all()
             # transports feeding learner_side must shut before its queue
             # closes (FleetTopology stops its DCN gateway here)

@@ -1,4 +1,5 @@
-"""Checkpointing.
+"""Checkpointing: params-only tier, legacy single-snapshot tier, and
+crash-consistent checkpoint EPOCHS.
 
 The reference checkpoints weights only: the evaluator torch.saves a
 state_dict every eval cycle (reference core/single_processes/evaluators.py:
@@ -6,25 +7,96 @@ state_dict every eval cycle (reference core/single_processes/evaluators.py:
 tester (reference testers.py:25) — optimizer state, counters, replay and RNG
 are all lost on resume (SURVEY.md §5 "checkpoint/resume: minimal").
 
-Here two tiers:
+Three tiers here:
 
 - **params-only** (reference-parity): a Flax-serialized msgpack of the param
   pytree at ``{model_name}.msgpack`` — written by the evaluator on its
   cadence, read by finetune/tester.  Restore needs a template tree of the
   same structure (``load_params(path, template)``).
-- **full train state** (the resume the reference lacks): Orbax checkpoint of
-  the whole ``TrainState`` (params + target + optimizer state + step) at
-  ``{model_name}_state/``; ``restore_train_state`` resumes the learner
-  exactly, counters included.
+- **legacy single snapshot**: Orbax checkpoint of the whole ``TrainState``
+  at ``{model_name}_state/`` plus a replay ``.npz`` — kept for
+  compatibility with pre-epoch runs.  ``save_train_state`` publishes via a
+  fresh directory + rename (never an in-place ``force=True`` overwrite), so
+  a crash mid-save cannot destroy the previous good snapshot.
+- **checkpoint epochs** (the crash-consistent resume tier): versioned
+  ``{model_name}_ckpt/epoch_<k>/`` directories, each holding the train
+  state, the replay contents, and an ``extras.json`` of clocks/counters,
+  evaluator best-score and per-role RNG states — all captured at ONE
+  moment and committed together by an atomic ``MANIFEST.json`` rename.
+  The manifest records the epoch number, the learner step, and a sha256
+  content digest per artifact; readers (``resolve_epoch``) scan newest
+  first and take the first epoch whose manifest exists and whose digests
+  verify, so a SIGKILL at ANY point of a save leaves either the new epoch
+  fully committed or the previous one untouched — never a torn triple of
+  learner-at-step-N with replay-from-step-M.  ``gc_epochs`` keeps the
+  newest ``retain`` committed epochs.  ``fsck`` (and the
+  ``tools/ckpt_fsck.py`` CLI) validates a checkpoint root offline.
+
+Fault drills: every epoch save consults a ``FaultInjector``
+(utils/faults.py) built from the ``CKPT_FAULTS`` env var, counting one
+frame per labelled write point (see ``_FRAME_POINTS``), so a kill-resume
+drill can SIGKILL the process at an exactly reproducible position —
+mid-Orbax-write, between the state and replay writes, or mid-manifest
+commit.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import shutil
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
 
 PyTree = Any
 
+MANIFEST = "MANIFEST.json"
+MANIFEST_FORMAT = 1
+_EPOCH_PREFIX = "epoch_"
+
+# frame indices fired per save_epoch call, in order — CKPT_FAULTS
+# schedules (e.g. ``kill@9``) target frame ``FRAMES_PER_SAVE * save_index
+# + point`` to die at an exact write boundary of an exact save
+_FRAME_POINTS = (
+    "begin",          # 0: before the epoch dir is (re)created
+    "mid_state",      # 1: Orbax save dispatched, not yet finished
+    "after_state",    # 2: state durable; replay not yet written
+    "mid_replay",     # 3: replay tmp written, not yet renamed in
+    "pre_commit",     # 4: all artifacts written, manifest not committed
+    "post_commit",    # 5: manifest committed, GC not yet run
+)
+FRAMES_PER_SAVE = len(_FRAME_POINTS)
+
+
+class CheckpointMismatch(RuntimeError):
+    """A restored snapshot does not fit the live run's configuration
+    (memory geometry/dtype/family changed between save and resume).
+    Raised with a field-level message instead of letting the mismatch
+    surface as a cryptic broadcast error deep inside JAX."""
+
+
+# ---------------------------------------------------------------------------
+# fault hook (kill-resume drills)
+# ---------------------------------------------------------------------------
+
+_faults_box: list = [None]
+
+
+def _faults():
+    """Process-wide injector for the checkpoint plane (``CKPT_FAULTS``).
+    One frame counter across all saves in the process, so a schedule can
+    name "the Nth write point since start" deterministically."""
+    if _faults_box[0] is None:
+        from pytorch_distributed_tpu.utils.faults import FaultInjector
+
+        _faults_box[0] = FaultInjector.from_env("ckpt")
+    return _faults_box[0]
+
+
+# ---------------------------------------------------------------------------
+# params-only tier (reference parity)
+# ---------------------------------------------------------------------------
 
 def save_params(path: str, params: PyTree) -> str:
     """Write a params-only checkpoint (msgpack).  Returns the path."""
@@ -53,20 +125,96 @@ def params_path(model_name: str) -> str:
     return model_name + ".msgpack"
 
 
+# ---------------------------------------------------------------------------
+# legacy single-snapshot tier
+# ---------------------------------------------------------------------------
+
+def best_score_path(model_name: str) -> str:
+    return model_name + "_best.json"
+
+
+def save_best_score(model_name: str, reward: float,
+                    step: Optional[int] = None) -> None:
+    """Sidecar committed WITH every ``<refs>_best.msgpack`` write: the
+    score that file's weights actually earned.  Checkpoint epochs also
+    carry the best score, but an eval can beat the record between two
+    epoch commits — a crash in that window would resume with a stale
+    threshold and let a worse policy overwrite the best params.  Resume
+    takes the max of both records (agents/learner.py)."""
+    _write_json_atomic(best_score_path(model_name),
+                       {"best_eval_reward": float(reward), "step": step})
+
+
+def load_best_score(model_name: str) -> float:
+    """The sidecar's score; -inf when absent or unreadable."""
+    try:
+        with open(best_score_path(model_name)) as f:
+            return float(json.load(f)["best_eval_reward"])
+    except (OSError, ValueError, KeyError):
+        return float("-inf")
+
+
 def state_dir(model_name: str) -> str:
     return os.path.abspath(model_name + "_state")
 
 
 def save_train_state(model_name: str, state: Any) -> str:
-    """Orbax save of the full TrainState (async-safe single snapshot)."""
+    """Orbax save of the full TrainState — crash-safe single snapshot.
+
+    Writes into a FRESH ``_state.new`` directory and publishes by rename:
+    the previous good snapshot is parked at ``_state.old`` for the one
+    instant between the two renames and deleted only after the new one is
+    in place, so no point of a SIGKILL can destroy the run's only
+    recovery state (the old ``force=True`` overwrite erased it first and
+    rebuilt in place).  ``restore_train_state`` knows the fallbacks."""
     import jax
     import orbax.checkpoint as ocp
 
     path = state_dir(model_name)
+    fresh = path + ".new"
+    old = path + ".old"
+    if not os.path.isdir(path):
+        # heal a crash-window store BEFORE purging debris: with ``path``
+        # absent, a complete snapshot may live only at ``.new`` (crash
+        # between the publish renames — the write always completes before
+        # any rename) or ``.old``; deleting it here and then dying
+        # mid-save would destroy the tier's only recovery point
+        for d in (fresh, old):
+            if os.path.isdir(d):
+                os.rename(d, path)
+                break
+    for d in (fresh, old):  # remaining debris from a previous crash
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, jax.device_get(state), force=True)
+    ckptr.save(fresh, jax.device_get(state))
     ckptr.wait_until_finished()
+    if os.path.isdir(path):
+        os.rename(path, old)
+    os.rename(fresh, path)
+    shutil.rmtree(old, ignore_errors=True)
     return path
+
+
+def restore_train_state(model_name: str, template: Any) -> Optional[Any]:
+    """Restore a TrainState saved by ``save_train_state``; None if absent.
+    Falls back across the publish window: ``_state`` first, then
+    ``_state.new`` (with ``_state`` absent the crash was between the two
+    publish renames, so ``.new`` is COMPLETE and one interval newer than
+    the parked ``.old``), then ``_state.old``."""
+    import orbax.checkpoint as ocp
+
+    path = state_dir(model_name)
+    ckptr = ocp.StandardCheckpointer()
+    for candidate in (path, path + ".new", path + ".old"):
+        if not os.path.isdir(candidate):
+            continue
+        try:
+            return ckptr.restore(candidate, template)
+        except Exception as e:  # noqa: BLE001 - torn dir: try the next tier
+            print(f"[checkpoint] {candidate} unreadable ({e}); "
+                  f"trying older snapshot")
+    return None
 
 
 def replay_path(model_name: str) -> str:
@@ -77,47 +225,499 @@ def save_replay(model_name: str, memory: Any) -> Optional[str]:
     """Write the replay contents next to the train state — the resume leg
     the reference never had (SURVEY.md §5 "Not checkpointed: ... replay").
     Works for any memory exposing ``snapshot() -> dict`` (shared ring, PER
-    incl. leaf priorities, HBM device rings; queue owners drain-then-
-    delegate).  Returns the path, or None when the memory type has no
-    snapshot surface."""
-    import numpy as np
-
-    if not hasattr(memory, "snapshot"):
-        return None
-    try:
-        data = memory.snapshot()
-    except NotImplementedError:  # wrapper around an unsupported memory
+    incl. leaf priorities, HBM device rings, host/HBM segment rings; queue
+    owners drain-then-delegate).  Returns the path, or None when the
+    memory type has no snapshot surface."""
+    data = snapshot_memory(memory)
+    if data is None:
         return None
     path = replay_path(model_name)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp.npz"
-    np.savez_compressed(tmp, **data)
-    os.replace(tmp, path)
+    _write_npz_atomic(path, data)
     return path
 
 
 def load_replay(model_name: str, memory: Any) -> bool:
     """Refill ``memory`` from a prior save_replay; False when absent or the
-    memory type has no restore surface."""
+    memory type has no restore surface.  Raises ``CheckpointMismatch``
+    when the snapshot's geometry no longer fits the live memory."""
     import numpy as np
 
     path = replay_path(model_name)
     if not hasattr(memory, "restore") or not os.path.exists(path):
         return False
     with np.load(path) as z:
-        try:
-            memory.restore({k: z[k] for k in z.files})
-        except NotImplementedError:
-            return False
+        data = {k: z[k] for k in z.files}
+    validate_snapshot(memory, data, source=path)
+    try:
+        memory.restore(data)
+    except NotImplementedError:
+        return False
     return True
 
 
-def restore_train_state(model_name: str, template: Any) -> Optional[Any]:
-    """Restore a TrainState saved by ``save_train_state``; None if absent."""
+def snapshot_memory(memory: Any) -> Optional[dict]:
+    """``memory.snapshot()`` with the duck-typing every save path shares:
+    None when the memory has no snapshot surface (or a queue owner wraps
+    one that doesn't)."""
+    if not hasattr(memory, "snapshot"):
+        return None
+    try:
+        return memory.snapshot()
+    except NotImplementedError:  # wrapper around an unsupported memory
+        return None
+
+
+# ---------------------------------------------------------------------------
+# snapshot <-> live-memory validation (CheckpointMismatch)
+# ---------------------------------------------------------------------------
+
+def _unwrap(memory: Any) -> Any:
+    """Queue owners delegate geometry to the wrapped memory; device
+    ingests to the attached ring."""
+    if hasattr(memory, "memory"):           # feeder.QueueOwner
+        return memory.memory
+    if getattr(memory, "replay", None) is not None:  # Device*Ingest
+        return memory.replay
+    return memory
+
+
+def validate_snapshot(memory: Any, data: dict, source: str = "snapshot"
+                      ) -> None:
+    """Check a replay snapshot against the live memory's geometry and
+    fail with a field-level ``CheckpointMismatch`` instead of a cryptic
+    broadcast error deep in the restore path.
+
+    Validated: schema family (transition vs segment rows), state/obs row
+    shape, state dtype.  A different CAPACITY is legal by design — every
+    restore keeps the newest rows that fit — but a shrink is reported to
+    stdout since it silently drops history."""
+    import numpy as np
+
+    mem = _unwrap(memory)
+    snap_is_seq = "obs" in data and "mask" in data
+    mem_is_seq = hasattr(mem, "T") or hasattr(mem, "seq_len")
+    name = type(mem).__name__
+
+    def bail(msg: str) -> None:
+        raise CheckpointMismatch(
+            f"{source} does not fit the live {name}: {msg} "
+            f"(memory/model config changed between save and resume?)")
+
+    if snap_is_seq != mem_is_seq:
+        bail("snapshot holds %s rows but the memory stores %s rows"
+             % ("segment" if snap_is_seq else "transition",
+                "segment" if mem_is_seq else "transition"))
+
+    if mem_is_seq:
+        obs = np.asarray(data["obs"])
+        want = getattr(mem, "obs_shape", None)
+        if want is None and hasattr(mem, "obs"):  # host SequenceReplay
+            want = tuple(np.shape(mem.obs)[1:])
+        if want is not None and len(obs) \
+                and tuple(obs.shape[1:]) != tuple(want):
+            bail(f"segment obs rows are {tuple(obs.shape[1:])}, "
+                 f"live ring stores {tuple(want)} "
+                 f"(seq_len/pack_frames/state shape changed)")
+        lstm = getattr(mem, "lstm_dim", None)
+        c0 = np.asarray(data.get("c0", np.zeros((0, 0))))
+        if lstm is not None and len(c0) and c0.shape[1] != lstm:
+            bail(f"carry width {c0.shape[1]} != live lstm_dim {lstm}")
+    else:
+        st = np.asarray(data["state0"])
+        want = getattr(mem, "state_shape", None)
+        if want is not None and len(st) \
+                and tuple(st.shape[1:]) != tuple(want):
+            bail(f"state rows are {tuple(st.shape[1:])}, live memory "
+                 f"stores {tuple(want)}")
+        want_dt = getattr(mem, "state_dtype", None)
+        if want_dt is not None and len(st) \
+                and np.dtype(st.dtype) != np.dtype(want_dt):
+            bail(f"state dtype {st.dtype} != live {np.dtype(want_dt)}")
+
+    cap = getattr(mem, "capacity", None)
+    rows = len(np.asarray(data.get("reward", ())))
+    if cap is not None and rows > cap:
+        print(f"[checkpoint] note: {source} holds {rows} rows, live "
+              f"{name} capacity is {cap} — restoring the newest {cap}")
+
+
+# ---------------------------------------------------------------------------
+# RNG state serialization (per-role, into epoch extras)
+# ---------------------------------------------------------------------------
+
+def serialize_np_rng(rng) -> dict:
+    """JSON-able state of a numpy Generator."""
+    return rng.bit_generator.state
+
+
+def restore_np_rng(rng, state: Optional[dict]) -> bool:
+    if not state:
+        return False
+    rng.bit_generator.state = state
+    return True
+
+
+def serialize_prng_key(key) -> list:
+    """JSON-able words of a JAX PRNG key (typed or raw uint32)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        key = jax.random.key_data(key)
+    return np.asarray(jax.device_get(key)).astype(np.uint32).tolist()
+
+
+def deserialize_prng_key(data, like):
+    """Rebuild a key serialized by ``serialize_prng_key``; ``like`` fixes
+    typed-vs-raw so the restored key drops into the saver's slot."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    raw = jnp.asarray(np.asarray(data, np.uint32))
+    if jnp.issubdtype(like.dtype, jax.dtypes.prng_key):
+        return jax.random.wrap_key_data(raw)
+    return raw
+
+
+# ---------------------------------------------------------------------------
+# checkpoint epochs
+# ---------------------------------------------------------------------------
+
+def ckpt_root(model_name: str) -> str:
+    return os.path.abspath(model_name + "_ckpt")
+
+
+def _epoch_dir(root: str, k: int) -> str:
+    return os.path.join(root, f"{_EPOCH_PREFIX}{k}")
+
+
+def _epoch_num(name: str) -> Optional[int]:
+    if not name.startswith(_EPOCH_PREFIX):
+        return None
+    try:
+        return int(name[len(_EPOCH_PREFIX):])
+    except ValueError:
+        return None
+
+
+def _list_epochs(root: str) -> List[Tuple[int, str]]:
+    """(k, path) for every epoch-shaped dir under root, newest first."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        k = _epoch_num(name)
+        p = os.path.join(root, name)
+        if k is not None and os.path.isdir(p):
+            out.append((k, p))
+    return sorted(out, reverse=True)
+
+
+def _digest_file(path: str) -> Tuple[str, int]:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for blk in iter(lambda: f.read(1 << 20), b""):
+            h.update(blk)
+    return h.hexdigest(), os.path.getsize(path)
+
+
+def _digest_tree(root: str) -> Tuple[str, int, int]:
+    """Digest of a directory artifact (the Orbax state dir): sha256 over
+    sorted relpaths + contents, so any torn/renamed/missing file flips
+    it.  Returns (hexdigest, total_bytes, file_count)."""
+    h = hashlib.sha256()
+    total = nfiles = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(p, root).encode() + b"\0")
+            with open(p, "rb") as f:
+                for blk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(blk)
+            total += os.path.getsize(p)
+            nfiles += 1
+    return h.hexdigest(), total, nfiles
+
+
+def _write_json_atomic(path: str, obj: dict) -> None:
+    """tmp write + fsync + rename + dir fsync: the commit primitive.
+    After the ``os.replace`` the file is either the complete new content
+    or absent — a reader can never observe a torn manifest."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_npz_atomic(path: str, data: dict, faults=None) -> None:
+    import numpy as np
+
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **data)
+    if faults is not None:
+        faults.frame()  # mid_replay: tmp durable, not yet published
+    with open(tmp, "rb") as f:
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+@dataclass
+class EpochInfo:
+    """A resolved (complete, digest-valid) checkpoint epoch."""
+
+    path: str
+    epoch: int
+    learner_step: int
+    manifest: dict
+    extras: dict = field(default_factory=dict)
+
+    @property
+    def has_state(self) -> bool:
+        return "state" in self.manifest.get("artifacts", {})
+
+    @property
+    def has_replay(self) -> bool:
+        return "replay.npz" in self.manifest.get("artifacts", {})
+
+
+def save_epoch(model_name: str, state: Any = None, memory: Any = None,
+               extras: Optional[dict] = None, retain: int = 3) -> str:
+    """Write one coordinated checkpoint epoch and commit it atomically.
+
+    Artifacts captured at THIS call, bound into one recovery point:
+    ``state/`` (Orbax TrainState), ``replay.npz`` (when ``memory`` has a
+    snapshot surface), ``extras.json`` (clocks/counters/best-score/RNG —
+    whatever dict the caller passes).  The epoch becomes visible to
+    readers only at the final atomic MANIFEST.json rename; a crash at any
+    earlier point leaves an uncommitted ``epoch_<k>`` that resolve/fsck
+    skip and the next save clears.  After commit, epochs beyond
+    ``retain`` are garbage-collected (newest kept)."""
+    faults = _faults()
+    faults.frame()  # begin
+    root = ckpt_root(model_name)
+    os.makedirs(root, exist_ok=True)
+    committed = [k for k, p in _list_epochs(root)
+                 if os.path.exists(os.path.join(p, MANIFEST))]
+    k = (committed[0] + 1) if committed else 0
+    ed = _epoch_dir(root, k)
+    if os.path.isdir(ed):  # uncommitted debris from a crashed save
+        shutil.rmtree(ed, ignore_errors=True)
+    os.makedirs(ed)
+
+    artifacts: Dict[str, dict] = {}
+    learner_step = int((extras or {}).get("learner_step", -1))
+
+    if state is not None:
+        import jax
+        import orbax.checkpoint as ocp
+
+        host_state = jax.device_get(state)
+        if learner_step < 0 and hasattr(host_state, "step"):
+            learner_step = int(host_state.step)
+        sd = os.path.join(ed, "state")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(sd, host_state)
+        faults.frame()  # mid_state: dispatched, possibly unfinished
+        ckptr.wait_until_finished()
+        digest, nbytes, nfiles = _digest_tree(sd)
+        artifacts["state"] = {"sha256": digest, "bytes": nbytes,
+                              "files": nfiles}
+    else:
+        faults.frame()  # keep the frame schedule position-stable
+
+    faults.frame()  # after_state
+    data = snapshot_memory(memory) if memory is not None else None
+    if data is not None:
+        rp = os.path.join(ed, "replay.npz")
+        _write_npz_atomic(rp, data, faults=faults)
+        digest, nbytes = _digest_file(rp)
+        artifacts["replay.npz"] = {
+            "sha256": digest, "bytes": nbytes,
+            "rows": int(len(data.get("reward", ())))}
+    else:
+        faults.frame()  # mid_replay placeholder
+
+    ep = os.path.join(ed, "extras.json")
+    _write_json_atomic(ep, dict(extras or {}))
+    digest, nbytes = _digest_file(ep)
+    artifacts["extras.json"] = {"sha256": digest, "bytes": nbytes}
+
+    faults.frame()  # pre_commit: everything durable, nothing visible
+    import time as _time
+
+    _write_json_atomic(os.path.join(ed, MANIFEST), {
+        "format": MANIFEST_FORMAT,
+        "epoch": k,
+        "learner_step": learner_step,
+        "wall": _time.time(),
+        "artifacts": artifacts,
+    })
+    faults.frame()  # post_commit
+    gc_epochs(root, retain=retain, in_progress=k)
+    return ed
+
+
+def verify_epoch(path: str) -> Tuple[str, List[str]]:
+    """(status, violations) for one epoch dir.
+
+    - ``complete``: manifest present, well-formed, every artifact's
+      digest verifies, extras consistent — violations empty.
+    - ``incomplete``: no manifest (a crash mid-save; expected debris,
+      not a violation).
+    - ``corrupt``: manifest present but lying — torn artifacts, digest
+      mismatches, inconsistent counters.  Every lie is listed.
+    """
+    mp = os.path.join(path, MANIFEST)
+    if not os.path.exists(mp):
+        return "incomplete", []
+    bad: List[str] = []
+    try:
+        with open(mp) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        return "corrupt", [f"{mp}: manifest unreadable ({e})"]
+    arts = man.get("artifacts")
+    if not isinstance(arts, dict) or "epoch" not in man:
+        return "corrupt", [f"{mp}: manifest missing required keys"]
+    k = _epoch_num(os.path.basename(path))
+    if k is not None and man["epoch"] != k:
+        bad.append(f"{mp}: manifest epoch {man['epoch']} != dir epoch {k}")
+    for name, meta in arts.items():
+        ap = os.path.join(path, name)
+        if name == "state":
+            if not os.path.isdir(ap):
+                bad.append(f"{ap}: state dir missing")
+                continue
+            digest, nbytes, nfiles = _digest_tree(ap)
+        elif not os.path.exists(ap):
+            bad.append(f"{ap}: artifact missing")
+            continue
+        else:
+            digest, nbytes = _digest_file(ap)
+        if digest != meta.get("sha256"):
+            bad.append(f"{ap}: content digest mismatch "
+                       f"(torn or modified after commit)")
+    if "extras.json" in arts and not any("extras.json" in b for b in bad):
+        try:
+            with open(os.path.join(path, "extras.json")) as f:
+                extras = json.load(f)
+        except (OSError, ValueError) as e:
+            extras = None
+            bad.append(f"{path}/extras.json: unreadable ({e})")
+        if extras is not None:
+            es = int(extras.get("learner_step", man.get("learner_step", -1)))
+            if es != int(man.get("learner_step", -1)):
+                bad.append(
+                    f"{path}: extras learner_step {es} != manifest "
+                    f"learner_step {man.get('learner_step')}")
+    return ("complete" if not bad else "corrupt"), bad
+
+
+def resolve_epoch(model_name: str) -> Optional[EpochInfo]:
+    """Newest COMPLETE epoch under ``{model_name}_ckpt``, or None.
+
+    Torn (uncommitted) and digest-mismatched epochs are skipped with a
+    note — a crash mid-save or a partially synced copy must cost at most
+    one epoch of progress, never the run."""
+    root = ckpt_root(model_name)
+    for k, path in _list_epochs(root):
+        status, bad = verify_epoch(path)
+        if status == "complete":
+            with open(os.path.join(path, MANIFEST)) as f:
+                man = json.load(f)
+            extras = {}
+            if os.path.exists(os.path.join(path, "extras.json")):
+                with open(os.path.join(path, "extras.json")) as f:
+                    extras = json.load(f)
+            return EpochInfo(path=path, epoch=k,
+                             learner_step=int(man.get("learner_step", -1)),
+                             manifest=man, extras=extras)
+        if status == "corrupt":
+            print(f"[checkpoint] skipping corrupt epoch {path}: "
+                  + "; ".join(bad))
+    return None
+
+
+def load_epoch_state(info: EpochInfo, template: Any) -> Any:
     import orbax.checkpoint as ocp
 
-    path = state_dir(model_name)
-    if not os.path.isdir(path):
-        return None
     ckptr = ocp.StandardCheckpointer()
-    return ckptr.restore(path, template)
+    return ckptr.restore(os.path.join(info.path, "state"), template)
+
+
+def load_epoch_replay(info: EpochInfo, memory: Any) -> int:
+    """Refill ``memory`` from the epoch's replay artifact.  Returns rows
+    restored (0 when the epoch has none or the memory can't restore).
+    Raises ``CheckpointMismatch`` on geometry drift."""
+    import numpy as np
+
+    if not info.has_replay or not hasattr(memory, "restore"):
+        return 0
+    with np.load(os.path.join(info.path, "replay.npz")) as z:
+        data = {k: z[k] for k in z.files}
+    validate_snapshot(memory, data, source=f"epoch {info.epoch} replay")
+    try:
+        out = memory.restore(data)
+    except NotImplementedError:
+        return 0
+    if isinstance(out, int):  # device/sequence restores report the truth
+        return out
+    # restore() without a count: saved rows capped at the live capacity
+    # (every restore keeps the newest rows that fit)
+    rows = int(info.manifest["artifacts"]["replay.npz"].get(
+        "rows", len(data.get("reward", ()))))
+    cap = getattr(_unwrap(memory), "capacity", None)
+    return min(rows, cap) if cap else rows
+
+
+def gc_epochs(root: str, retain: int = 3,
+              in_progress: Optional[int] = None) -> List[str]:
+    """Delete committed epochs beyond the newest ``retain`` plus any
+    uncommitted debris (except ``in_progress``, the epoch a caller is
+    mid-writing).  Returns the paths removed."""
+    removed = []
+    committed = []
+    for k, path in _list_epochs(root):
+        if os.path.exists(os.path.join(path, MANIFEST)):
+            committed.append((k, path))
+        elif k != in_progress:
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+    for k, path in committed[max(retain, 1):]:
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    return removed
+
+
+def fsck(root: str) -> dict:
+    """Offline validation of a checkpoint root (the ``tools/ckpt_fsck.py``
+    engine).  Returns a report dict; ``violations`` non-empty means a
+    COMMITTED epoch is lying about its contents — incomplete epochs are
+    expected crash debris and only reported."""
+    report: dict = {"root": root, "epochs": [], "violations": [],
+                    "newest_complete": None}
+    if not os.path.isdir(root):
+        report["violations"].append(f"{root}: no such directory")
+        return report
+    for k, path in _list_epochs(root):
+        status, bad = verify_epoch(path)
+        entry = {"epoch": k, "status": status, "violations": bad}
+        if status == "complete":
+            with open(os.path.join(path, MANIFEST)) as f:
+                entry["learner_step"] = json.load(f).get("learner_step")
+            if report["newest_complete"] is None:
+                report["newest_complete"] = k
+        report["epochs"].append(entry)
+        report["violations"].extend(bad)
+    return report

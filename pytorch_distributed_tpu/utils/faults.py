@@ -30,13 +30,18 @@ Actions (``action@frame`` or ``action@frame:arg``):
 - ``crash@N``          — raise ``InjectedCrash`` at frame N.  Uncaught by
   design: an actor process dies nonzero (its RestartBudget engages), a
   gateway serve thread dies and frees its slot.
+- ``kill@N``           — SIGKILL the whole process at frame N.  Nothing
+  can catch or clean up after it — exactly a host OOM-kill or TPU
+  preemption hard-stop.  The checkpoint kill-resume drills
+  (utils/checkpoint.py save_epoch write points, ``CKPT_FAULTS`` env)
+  use it to die MID-write and prove the epoch commit protocol.
 
 Injectors are wired through env vars so fault schedules reach spawn
 children without plumbing: ``DCN_FAULTS_CLIENT`` / ``DCN_FAULTS_GATEWAY``
-hold either a scripted spec or ``random:SEED`` (see
-``FaultInjector.from_env``); fleet.py exposes them as ``--faults-client``
-/ ``--faults-gateway`` CLI knobs.  No spec = a null injector whose
-per-frame cost is one lock + dict probe.
+/ ``CKPT_FAULTS`` hold either a scripted spec or ``random:SEED`` (see
+``FaultInjector.from_env``); fleet.py exposes the DCN pair as
+``--faults-client`` / ``--faults-gateway`` CLI knobs.  No spec = a null
+injector whose per-frame cost is one lock + dict probe.
 """
 
 from __future__ import annotations
@@ -48,7 +53,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 FaultEvent = Tuple[int, str, float]  # (frame index, action, arg)
 
-_ACTIONS = ("sever", "delay", "blackhole", "corrupt", "crash")
+_ACTIONS = ("sever", "delay", "blackhole", "corrupt", "crash", "kill")
 
 # default per-frame probabilities for the random mode — light enough that
 # a healthy session layer rides through, frequent enough that a soak of a
@@ -138,11 +143,14 @@ class FaultInjector:
 
     @classmethod
     def from_env(cls, role: str) -> "FaultInjector":
-        """``DCN_FAULTS_CLIENT`` / ``DCN_FAULTS_GATEWAY``: a scripted
-        spec, or ``random:SEED[:HORIZON]``.  Unset/empty -> null
-        injector.  Per-process (spawn children inherit the env), which is
-        what a kill-actor-at-step-N drill needs."""
-        spec = os.environ.get(f"DCN_FAULTS_{role.upper()}", "").strip()
+        """``DCN_FAULTS_CLIENT`` / ``DCN_FAULTS_GATEWAY`` (wire roles) or
+        ``{ROLE}_FAULTS`` (other planes, e.g. ``CKPT_FAULTS`` for the
+        checkpoint writer): a scripted spec, or ``random:SEED[:HORIZON]``.
+        Unset/empty -> null injector.  Per-process (spawn children
+        inherit the env), which is what a kill-at-step-N drill needs."""
+        var = (f"DCN_FAULTS_{role.upper()}" if role in ("client", "gateway")
+               else f"{role.upper()}_FAULTS")
+        spec = os.environ.get(var, "").strip()
         if not spec:
             return cls(name=role)
         if spec.startswith("random:"):
@@ -177,6 +185,13 @@ class FaultInjector:
             elif action == "crash":
                 raise InjectedCrash(
                     f"[faults:{self.name}] injected crash at frame {n}")
+            elif action == "kill":
+                import signal
+
+                # stdout may never flush — that's the point of SIGKILL
+                print(f"[faults:{self.name}] SIGKILL at frame {n}",
+                      flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
             elif action == "corrupt":
                 if payload:
                     mutated = bytearray(payload)

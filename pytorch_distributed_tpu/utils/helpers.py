@@ -114,3 +114,20 @@ def global_norm(tree: PyTree) -> jnp.ndarray:
 
 def tree_size(tree: PyTree) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across jax versions: newer releases expose it at
+    top level with the ``check_vma`` kwarg; 0.4.x ships it as
+    ``jax.experimental.shard_map.shard_map`` with the same knob named
+    ``check_rep``.  One compat entry so the sp/pp kernels (ops/
+    ring_attention.py, parallel/pipeline.py) run on either."""
+    try:
+        sm = jax.shard_map
+    except AttributeError:  # jax 0.4.x
+        from jax.experimental.shard_map import shard_map as sm_old
+
+        return sm_old(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_vma=check_vma)

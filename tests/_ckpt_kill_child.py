@@ -1,0 +1,55 @@
+"""Child process for the FAST kill-resume drill
+(tests/test_checkpoint_epochs.py TestKillDrill): performs a sequence of
+checkpoint-epoch saves of a small synthetic train state + replay, with
+the ``CKPT_FAULTS`` env schedule (utils/faults.py, ``kill@FRAME``)
+SIGKILLing the process at an exact write point — mid-Orbax-write,
+between the state and replay writes, mid-manifest-commit
+(utils/checkpoint.py ``_FRAME_POINTS``).
+
+Run: python _ckpt_kill_child.py <model_name> <saves>
+Prints ``COMMITTED <k> <step>`` after each surviving save and ``DONE``
+if the schedule never fired."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def main() -> None:
+    model_name, saves = sys.argv[1], int(sys.argv[2])
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_distributed_tpu.memory.shared_replay import SharedReplay
+    from pytorch_distributed_tpu.utils import checkpoint as ckpt
+    from pytorch_distributed_tpu.utils.experience import Transition
+
+    mem = SharedReplay(capacity=64, state_shape=(4,), action_shape=(),
+                       state_dtype=np.uint8, action_dtype=np.int32)
+    rng = np.random.default_rng(0)
+    step = 0
+    for k in range(saves):
+        for _ in range(8):
+            mem.feed(Transition(
+                state0=rng.integers(0, 255, (4,)).astype(np.uint8),
+                action=np.int32(0), reward=np.float32(step),
+                gamma_n=np.float32(0.99),
+                state1=rng.integers(0, 255, (4,)).astype(np.uint8),
+                terminal1=np.float32(0.0)))
+        step += 10
+        state = {"w": jnp.full((16,), float(step)), "step": jnp.int32(step)}
+        ckpt.save_epoch(model_name, state=state, memory=mem,
+                        extras={"learner_step": step,
+                                "actor_step": step * 3},
+                        retain=3)
+        print(f"COMMITTED {k} {step}", flush=True)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Child process for the SLOW full-topology kill/preemption-resume
+drills (tests/test_checkpoint_epochs.py TestTopologyDrills): one real
+thread-backend training run (config 1, fake chain env) with the
+checkpoint-epoch cadence on, optionally SIGKILLed mid-save by a
+``CKPT_FAULTS`` schedule or SIGTERMed (preemption notice) by the parent.
+
+Run: python _kill_resume_child.py <root_dir> <refs> <steps> <resume_mode>
+Prints ``FINAL lstep=<n> actor=<n> preempted=<0|1>`` on a clean exit."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def main() -> None:
+    root, refs, steps, resume = (sys.argv[1], sys.argv[2],
+                                 int(sys.argv[3]), sys.argv[4])
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_distributed_tpu import runtime
+    from pytorch_distributed_tpu.config import build_options
+
+    opt = build_options(
+        config=1, root_dir=root, refs=refs, steps=steps, resume=resume,
+        num_actors=1, learn_start=16, batch_size=8, memory_size=512,
+        logger_freq=1, evaluator_freq=1, evaluator_nepisodes=1,
+        visualize=False, early_stop=25, max_replay_ratio=16.0,
+        checkpoint_replay=True, checkpoint_freq=10, checkpoint_retain=3,
+        max_seconds=300.0)
+    topo = runtime.train(opt, backend="thread")
+    print(f"FINAL lstep={topo.clock.learner_step.value} "
+          f"actor={topo.clock.actor_step.value} "
+          f"preempted={int(topo.preempted.is_set())}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -178,9 +178,19 @@ def test_topology_resume_with_warm_replay(tmp_path):
     opt = build_options(config=1, steps=200, **common)
     topo = runtime.train(opt, backend="thread")
     assert topo.clock.learner_step.value >= 200
-    assert (tmp_path / "models" / (opt.refs + "_replay.npz")).exists()
+    # the run's final write is a committed checkpoint EPOCH binding train
+    # state + replay + counters into one digest-valid triple
+    info = ckpt.resolve_epoch(opt.model_name)
+    assert info is not None and info.has_state and info.has_replay
+    assert info.learner_step >= 200
+    assert info.extras["replay_size"] > 0
+    actor1 = info.extras["actor_step"]
 
     opt2 = build_options(config=1, steps=400, refs=opt.refs, **common)
     topo2 = runtime.train(opt2, backend="thread")
     # step counter resumed past the first run's 200 and reached 400
     assert topo2.clock.learner_step.value >= 400
+    # clock counters carried across the resume (cumulative, no reset)
+    info2 = ckpt.resolve_epoch(opt.model_name)
+    assert info2.learner_step >= 400
+    assert info2.extras["actor_step"] >= actor1

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Offline validator for checkpoint-epoch directories.
+
+A checkpoint that can't be trusted is worse than none: after a host
+crash, a TPU preemption, or a partially synced copy, this command tells
+you — without starting a run — whether a ``{model_name}_ckpt`` directory
+still holds a recovery point the resume path will accept
+(utils/checkpoint.py resolve_epoch applies exactly the same rules).
+
+    python tools/ckpt_fsck.py models/run_ckpt
+    python tools/ckpt_fsck.py models/run            # _ckpt suffix implied
+    python tools/ckpt_fsck.py --require-complete models/*_ckpt
+
+Per epoch it reports one of:
+
+- ``complete``   — manifest committed and every artifact's sha256 digest
+  verifies; counters consistent between manifest and extras.  Resumable.
+- ``incomplete`` — no MANIFEST.json: a save was killed before its atomic
+  commit.  Expected crash debris, NOT a violation (the next run's save
+  clears it); an older complete epoch still carries the run.
+- ``corrupt``    — a committed manifest is lying (missing artifact, digest
+  mismatch, inconsistent learner_step).  Every lie is listed and counted
+  as a violation.
+
+Exit codes: 0 = no violations (every committed epoch is whole);
+1 = violations found; 2 = a named path is not a checkpoint directory.
+``--require-complete`` additionally fails (1) when a directory has no
+complete epoch at all — what a kill-resume drill asserts after the first
+commit has happened.
+
+The final line is a JSON report for scripting (one object per root).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_tpu.utils import checkpoint as ckpt
+
+
+def fsck_path(path: str) -> dict:
+    """Accept either the ``*_ckpt`` root itself or the model_name prefix
+    it was derived from."""
+    root = path
+    if not os.path.isdir(root) and os.path.isdir(path + "_ckpt"):
+        root = path + "_ckpt"
+    return ckpt.fsck(root)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+",
+                    help="checkpoint roots (*_ckpt dirs) or model_name "
+                         "prefixes")
+    ap.add_argument("--require-complete", action="store_true",
+                    help="also fail when a root holds no complete epoch")
+    args = ap.parse_args(argv)
+
+    reports = []
+    rc = 0
+    for path in args.paths:
+        rep = fsck_path(path)
+        reports.append(rep)
+        if not os.path.isdir(rep["root"]):
+            print(f"[ckpt_fsck] {path}: not a checkpoint directory")
+            rc = max(rc, 2)
+            continue
+        for e in sorted(rep["epochs"], key=lambda e: e["epoch"]):
+            line = f"[ckpt_fsck] {rep['root']} epoch {e['epoch']}: " \
+                   f"{e['status']}"
+            if e["status"] == "complete":
+                line += f" (learner_step {e.get('learner_step')})"
+            print(line)
+            for v in e["violations"]:
+                print(f"[ckpt_fsck]   VIOLATION: {v}")
+        if rep["violations"]:
+            rc = max(rc, 1)
+        if args.require_complete and rep["newest_complete"] is None:
+            print(f"[ckpt_fsck] {rep['root']}: no complete epoch")
+            rc = max(rc, 1)
+        if not rep["epochs"]:
+            print(f"[ckpt_fsck] {rep['root']}: empty checkpoint root")
+    print(json.dumps(reports))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,165 @@ def _has(mod: str) -> bool:
     return importlib.util.find_spec(mod) is not None
 
 
+# ---------------------------------------------------------------------------
+# snapshot/restore contract (ungated: runs on every machine)
+# ---------------------------------------------------------------------------
+
+def check_snapshot_restore_contract() -> dict:
+    """Every memory class exposing ``snapshot`` must expose ``restore``,
+    and the pair must round-trip a small buffer — the invariant the
+    checkpoint-epoch subsystem (utils/checkpoint.py) builds on.  Two
+    passes:
+
+    1. reflective: walk every class defined under
+       ``pytorch_distributed_tpu.memory`` and reject any that has one
+       half of the surface without the other;
+    2. dynamic: feed/snapshot/restore each concrete replay family and
+       check size + contents survive.
+    """
+    import importlib
+    import pkgutil
+
+    import numpy as np
+
+    import pytorch_distributed_tpu.memory as mempkg
+    from pytorch_distributed_tpu.utils.experience import Transition
+
+    one_sided = []
+    scanned = 0
+    for m in pkgutil.iter_modules(mempkg.__path__):
+        mod = importlib.import_module(f"{mempkg.__name__}.{m.name}")
+        for name in dir(mod):
+            cls = getattr(mod, name)
+            if not isinstance(cls, type) \
+                    or getattr(cls, "__module__", "") != mod.__name__:
+                continue
+            has_snap = callable(getattr(cls, "snapshot", None))
+            has_rest = callable(getattr(cls, "restore", None))
+            if has_snap or has_rest:
+                scanned += 1
+            if has_snap != has_rest:
+                one_sided.append(f"{mod.__name__}.{name}")
+    assert not one_sided, (
+        f"memory classes with a one-sided snapshot/restore surface "
+        f"(checkpoints written there could never be read back): "
+        f"{one_sided}")
+
+    def geom(cap):
+        return dict(capacity=cap, state_shape=(4,), action_shape=(),
+                    state_dtype=np.uint8, action_dtype=np.int32)
+
+    def fill(mem, n):
+        rng = np.random.default_rng(0)
+        for i in range(n):
+            mem.feed(Transition(
+                state0=rng.integers(0, 255, (4,)).astype(np.uint8),
+                action=np.int32(i % 3), reward=np.float32(i),
+                gamma_n=np.float32(0.99),
+                state1=rng.integers(0, 255, (4,)).astype(np.uint8),
+                terminal1=np.float32(0.0)), float(i % 5))
+
+    def roundtrip(make, feed, rows_of):
+        a, b = make(), make()
+        feed(a)
+        b.restore(a.snapshot())
+        assert b.size == a.size, (type(a).__name__, b.size, a.size)
+        np.testing.assert_allclose(np.sort(rows_of(b)), np.sort(rows_of(a)))
+
+    from pytorch_distributed_tpu.memory.feeder import QueueOwner
+    from pytorch_distributed_tpu.memory.prioritized import PrioritizedReplay
+    from pytorch_distributed_tpu.memory.sequence_replay import (
+        Segment, SequenceReplay,
+    )
+    from pytorch_distributed_tpu.memory.shared_replay import SharedReplay
+
+    checked = []
+    host_reward = lambda m: np.asarray(m.reward if hasattr(m, "reward")
+                                       else m._np_reward)[:m.size].copy()
+    for ctor in (SharedReplay, PrioritizedReplay):
+        roundtrip(lambda c=ctor: c(**geom(32)), lambda m: fill(m, 20),
+                  host_reward)
+        checked.append(ctor.__name__)
+
+    def feed_segments(mem, n):
+        for i in range(n):
+            mem.feed(Segment(
+                obs=np.full((9, 4), i, np.float32),
+                action=np.zeros(8, np.int32),
+                reward=np.full(8, i, np.float32),
+                terminal=np.zeros(8, np.float32),
+                mask=np.ones(8, np.float32),
+                c0=np.zeros(3, np.float32), h0=np.zeros(3, np.float32)))
+
+    roundtrip(
+        lambda: SequenceReplay(capacity=16, seq_len=8, state_shape=(4,),
+                               lstm_dim=3, state_dtype=np.float32),
+        lambda m: feed_segments(m, 10),
+        lambda m: np.asarray(m.reward)[:m.size, 0].copy())
+    checked.append("SequenceReplay")
+
+    # drain-then-delegate: rows still queued by feeders must land in the
+    # snapshot (the coordinated-epoch guarantee for single-owner
+    # memories).  mp.Queue delivers through a background feeder thread,
+    # so poll briefly for the pipe — in the learner the per-step drain
+    # cadence absorbs this latency.
+    owner = QueueOwner(SharedReplay(**geom(32)))
+    feeder = owner.make_feeder(chunk=4)
+    fill(feeder, 8)
+    deadline = time.monotonic() + 10
+    snap = owner.snapshot()
+    while len(snap["reward"]) < 8 and time.monotonic() < deadline:
+        time.sleep(0.05)
+        snap = owner.snapshot()
+    assert len(snap["reward"]) == 8, len(snap["reward"])
+    owner.close()
+    checked.append("QueueOwner")
+
+    # HBM families (CPU backend here; same code path as on-device)
+    from pytorch_distributed_tpu.memory.device_per import DevicePerReplay
+    from pytorch_distributed_tpu.memory.device_replay import DeviceReplay
+    from pytorch_distributed_tpu.memory.device_sequence import (
+        DeviceSequenceReplay, SegmentChunk,
+    )
+
+    def feed_dev(mem, n):
+        rng = np.random.default_rng(0)
+        mem.feed_chunk(Transition(
+            state0=rng.integers(0, 255, (n, 4)).astype(np.uint8),
+            action=np.zeros(n, np.int32),
+            reward=np.arange(n, dtype=np.float32),
+            gamma_n=np.full(n, 0.99, np.float32),
+            state1=rng.integers(0, 255, (n, 4)).astype(np.uint8),
+            terminal1=np.zeros(n, np.float32)))
+
+    import jax
+
+    dev_reward = lambda m: np.asarray(
+        jax.device_get(m.state.reward))[:m.size].copy()
+    for ctor in (DeviceReplay, DevicePerReplay):
+        roundtrip(lambda c=ctor: c(**geom(32)), lambda m: feed_dev(m, 20),
+                  dev_reward)
+        checked.append(ctor.__name__)
+
+    roundtrip(
+        lambda: DeviceSequenceReplay(capacity=16, seq_len=8,
+                                     state_shape=(4,), lstm_dim=3,
+                                     state_dtype=np.float32),
+        lambda m: m.feed_chunk(SegmentChunk(
+            obs=np.zeros((10, 9, 4), np.float32),
+            action=np.zeros((10, 8), np.int32),
+            reward=np.tile(np.arange(10, dtype=np.float32)[:, None], 8),
+            terminal=np.zeros((10, 8), np.float32),
+            mask=np.ones((10, 8), np.float32),
+            c0=np.zeros((10, 3), np.float32),
+            h0=np.zeros((10, 3), np.float32))),
+        lambda m: np.asarray(
+            jax.device_get(m.state.reward))[:m.size, 0].copy())
+    checked.append("DeviceSequenceReplay")
+
+    return {"scanned": scanned, "round_tripped": checked}
+
+
 def detect_backends() -> dict:
     """Which gated backends exist on THIS machine."""
     out = {
@@ -143,6 +302,16 @@ def main() -> int:
 
     results = {}
     failed = False
+    # ungated: the snapshot/restore contract must hold on every machine
+    try:
+        snap_contract = check_snapshot_restore_contract()
+        print(f"[field_check] snapshot/restore contract: OK "
+              f"{snap_contract}")
+    except Exception as e:  # noqa: BLE001 - report and fail the run
+        failed = True
+        snap_contract = {"status": "fail", "error": repr(e)}
+        print(f"[field_check] snapshot/restore contract: FAIL {e!r}")
+        traceback.print_exc()
     for row, (label, backend) in sorted(GATED_ROWS.items()):
         if args.rows is not None and row not in args.rows:
             continue
@@ -163,7 +332,9 @@ def main() -> int:
             print(f"[field_check] row {row:>2} {label}: FAIL {e!r}")
             traceback.print_exc()
 
-    print(json.dumps({"backends": backends, "rows": results}))
+    print(json.dumps({"backends": backends,
+                      "snapshot_contract": snap_contract,
+                      "rows": results}))
     return 1 if failed else 0
 
 
