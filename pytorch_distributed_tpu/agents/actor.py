@@ -116,12 +116,21 @@ class _ActorHarness:
         self._next_flush = self.ap.actor_freq
         self._next_sync = self.ap.actor_sync_freq
 
+        from pytorch_distributed_tpu.utils import tracing
         from pytorch_distributed_tpu.utils.metrics import MetricsWriter
         from pytorch_distributed_tpu.utils.profiling import StepTimer
 
         self.timer = StepTimer("actor")
-        self._timing_writer = MetricsWriter(opt.log_dir,
-                                            enable_tensorboard=False)
+        self._timing_writer = MetricsWriter(
+            opt.log_dir, enable_tensorboard=False,
+            role=f"actor-{process_ind}", run_id=opt.refs)
+        # distributed-trace origin: every chunk this actor flushes is
+        # stamped with a trace id here and records an "enqueue" span (a
+        # blocking put IS backpressure); downstream hops — gateway, feed,
+        # sample, learn — attach to the same id (utils/tracing.py)
+        self.tracer = tracing.get_tracer("actor")
+        if hasattr(memory, "set_tracer"):
+            memory.set_tracer(self.tracer)
 
     # -- one vector tick ----------------------------------------------------
 
@@ -175,8 +184,9 @@ class _ActorHarness:
         if self.env_steps >= self._next_flush:
             self._next_flush += self.ap.actor_freq
             self.flush_stats()
-            self._timing_writer.scalars(self.timer.drain(),
-                                        step=self.clock.learner_step.value)
+            step = self.clock.learner_step.value
+            self._timing_writer.scalars(self.timer.drain(), step=step)
+            self.tracer.flush_to(self._timing_writer, step=step)
             if hasattr(self.memory, "flush"):
                 self.memory.flush()  # queue feeders drain on the cadence
         if self.env_steps >= self._next_sync:
@@ -254,6 +264,8 @@ class _ActorHarness:
 
         if isinstance(self.memory, QueueFeeder):
             self.memory.close()
+        self.tracer.flush_to(self._timing_writer,
+                             step=self.clock.learner_step.value)
         self._timing_writer.close()
 
 

@@ -46,6 +46,7 @@ from pytorch_distributed_tpu.memory.device_sequence import (
 )
 from pytorch_distributed_tpu.memory.feeder import QueueOwner
 from pytorch_distributed_tpu.utils import checkpoint as ckpt
+from pytorch_distributed_tpu.utils import tracing
 from pytorch_distributed_tpu.utils.metrics import MetricsWriter
 from pytorch_distributed_tpu.utils.profiling import StepTimer
 from pytorch_distributed_tpu.utils.rngs import np_rng
@@ -353,7 +354,19 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     timer = StepTimer("learner")
     # per-phase timings go straight to the run's JSONL stream (appends are
     # atomic line writes; the logger process keeps the aggregated scalars)
-    timing_writer = MetricsWriter(opt.log_dir, enable_tensorboard=False)
+    timing_writer = MetricsWriter(opt.log_dir, enable_tensorboard=False,
+                                  role="learner", run_id=opt.refs)
+    # distributed-trace tail: sample/learn spans attach to the most recent
+    # trace id the replay drain observed (utils/tracing.py), closing the
+    # actor→gateway→feed→sample→learn chain; the learner also flushes the
+    # in-process "feeder" and "gateway" tracers — both record on threads
+    # of THIS process (the drain path and the DCN serve threads)
+    tracer = tracing.get_tracer("learner")
+
+    def _flush_traces(step: int) -> None:
+        for t in (tracer, tracing.get_tracer("feeder"),
+                  tracing.get_tracer("gateway")):
+            t.flush_to(timing_writer, step=step)
 
     def _save_epoch() -> None:
         """One coordinated checkpoint epoch: train state + replay +
@@ -415,7 +428,8 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                 if is_device_per:
                     beta_dev = jax.device_put(
                         np.float32(replay.beta(lstep)))
-            with timer.phase("step"):
+            with timer.phase("step"), \
+                    tracer.span("learn", trace_id=tracing.current_trace()):
                 metrics = device_step(key_buf.pop())
                 if block_each_step:
                     jax.block_until_ready(state.params)
@@ -423,9 +437,12 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             if is_per:
                 with timer.phase("drain"):
                     memory.drain()
-            with timer.phase("sample"):
+            with timer.phase("sample"), \
+                    tracer.span("sample",
+                                trace_id=tracing.current_trace()):
                 batch = memory.sample(ap.batch_size, rng)
-            with timer.phase("step"):
+            with timer.phase("step"), \
+                    tracer.span("learn", trace_id=tracing.current_trace()):
                 state, metrics, td_abs = learner.step(state, batch)
             if is_per:
                 with timer.phase("priorities"):
@@ -463,6 +480,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                 / max(now - t_cadence, 1e-9),
             )
             timing_writer.scalars(timer.drain(), step=lstep)
+            _flush_traces(lstep)
             t_cadence = now
             last_stats_lstep = lstep
 
@@ -476,6 +494,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         _pub_thread.join(timeout=120)
     _publish(state)
     _save_epoch()
+    _flush_traces(lstep)  # tail spans of the final partial window
     timing_writer.close()
 
 

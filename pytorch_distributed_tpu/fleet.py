@@ -82,6 +82,10 @@ class FleetTopology(Topology):
                  spec=None):
         super().__init__(opt, spec=spec)
         self.local_actors = min(local_actors, opt.num_actors)
+        # learner-step-rate sampling state for the health snapshot: STATUS
+        # requests land on concurrent gateway serve threads
+        self._rate_lock = threading.Lock()
+        self._rate_prev = None  # (monotonic, learner_step) of last probe
         self.gateway = self._make_gateway(port)
         self.port = self.gateway.port
 
@@ -96,7 +100,55 @@ class FleetTopology(Topology):
         return DcnGateway(
             self.param_store, self.clock, self.actor_stats,
             put_chunk=feed_queue_of(self.handles), port=port,
-            local_actors=self.local_actors)
+            local_actors=self.local_actors,
+            health=self._health_snapshot)
+
+    def _health_snapshot(self) -> dict:
+        """Topology-level fields for the gateway's STATUS verb: the parts
+        of the health plane only the learner-host wiring can see.  Reads
+        are best-effort snapshots of live structures (sizes, counters) —
+        racing the learner by one step is fine, blocking it is not."""
+        h: dict = {"run_id": self.opt.refs}
+        ls = self.handles.learner_side
+        try:  # size/capacity are properties; a device ring raises
+            size = int(ls.size)  # pre-attach — skip, don't crash STATUS
+            h["replay_size"] = size
+            cap = int(getattr(ls, "capacity", 0))
+            if cap:
+                h["replay_capacity"] = cap
+                h["replay_fill"] = round(size / cap, 4)
+        except Exception:  # noqa: BLE001
+            pass
+        q = getattr(ls, "_q", None)
+        if q is not None and hasattr(q, "qsize"):
+            try:
+                h["ingest_queue_depth"] = int(q.qsize())
+                h["ingest_queue_bound"] = int(
+                    getattr(ls, "max_queue_chunks", 0))
+            except (NotImplementedError, OSError):
+                pass  # macOS mp queues have no qsize
+        now = time.monotonic()
+        step = int(self.clock.learner_step.value)
+        with self._rate_lock:
+            prev = self._rate_prev
+            # advance the window anchor only after it has real width:
+            # concurrent probers (a fleet_top refresh loop + a CI probe)
+            # would otherwise shrink each other's windows to a few ms,
+            # quantizing the rate into 0-or-thousands flapping
+            if prev is None or now - prev[0] >= 0.5:
+                self._rate_prev = (now, step)
+        if prev is not None and now > prev[0]:
+            h["learner_steps_per_sec"] = round(
+                (step - prev[1]) / (now - prev[0]), 3)
+        budget = self._restart_budget
+        if budget is not None:
+            # scope is honest in the name: the runtime monitor only
+            # supervises the learner host's LOCAL actor slots
+            # (ind < local_actors); remote slots are supervised by their
+            # own actor host's RestartBudget, which never reaches here
+            h["local_restart_budget_remaining"] = {
+                str(s): r for s, r in budget.remaining().items()}
+        return h
 
     def _worker_specs(self):
         # local actor slots are [0, local_actors); remote hosts take the
@@ -162,9 +214,13 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
         DcnClient, DcnRefused, RemoteClock, RemoteMemory, RemoteParamStore,
         RemoteStats,
     )
+    from pytorch_distributed_tpu.utils import flight_recorder
     from pytorch_distributed_tpu.utils.supervision import EXIT_DISCONNECTED
 
+    flight_recorder.configure(opt.log_dir)
+    recorder = flight_recorder.get_recorder(f"actor-{process_ind}")
     host, port = coordinator.rsplit(":", 1)
+    recorder.record("session-start", coordinator=coordinator)
     try:
         client = DcnClient((host, int(port)), process_ind=process_ind)
     except (ConnectionError, OSError, DcnRefused) as e:
@@ -175,6 +231,9 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
         # propagates as the crash it is
         print(f"[fleet] actor-{process_ind} could not establish its DCN "
               f"session ({e}); exiting {EXIT_DISCONNECTED}")
+        recorder.record("session-refused", error=repr(e))
+        flight_recorder.dump_all(
+            f"actor-{process_ind} could not establish DCN session")
         sys.exit(EXIT_DISCONNECTED)
     memory = RemoteMemory(client)
     clock = RemoteClock(client)
@@ -201,7 +260,13 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
     if client.disconnected.is_set() and not client.stop.is_set():
         print(f"[fleet] actor-{process_ind} lost its DCN session; "
               f"exiting {EXIT_DISCONNECTED} for the supervisor")
+        # the client already dumped when it latched the loss
+        # (DcnClient._terminal); this records how the ROLE ended
+        recorder.record("session-lost", reconnects=client.reconnects)
+        flight_recorder.dump_all(
+            f"actor-{process_ind} DCN session lost")
         sys.exit(EXIT_DISCONNECTED)
+    recorder.record("run-complete", reconnects=client.reconnects)
 
 
 def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
@@ -273,10 +338,13 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
                 f"{bad} — see utils/supervision.describe_exit")
         return []
 
+    from pytorch_distributed_tpu.utils import flight_recorder
     from pytorch_distributed_tpu.utils.supervision import (
         RestartBudget, describe_exit,
     )
 
+    flight_recorder.configure(opt.log_dir, export_env=True)
+    host_recorder = flight_recorder.get_recorder("fleet-host")
     budget = RestartBudget(max_restarts=max_restarts, backoff=True)
     for ind in workers:
         budget.note_birth(ind)
@@ -315,11 +383,17 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
                       f"({describe_exit(w.exitcode)}); "
                       f"restart {budget.count(ind)}/{max_restarts} "
                       f"in {delay:.0f}s")
+                host_recorder.record("worker-restarted", slot=ind,
+                                     exit=w.exitcode,
+                                     restarts=budget.count(ind),
+                                     delay=delay)
                 del workers[ind]
                 pending[ind] = now + delay
             else:
                 print(f"[fleet] actor-{ind} out of restart budget; "
                       f"abandoning slot")
+                host_recorder.record("slot-abandoned", slot=ind,
+                                     exit=w.exitcode)
                 del workers[ind]
                 abandoned.append(ind)
         if abandoned:
@@ -329,6 +403,9 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
             # degradation this supervision exists to prevent.  Terminate
             # the survivors and surface the failure NOW — the outer
             # orchestrator restarts the whole host with a fresh budget.
+            flight_recorder.dump_all(
+                f"actor host failing fast: slots {abandoned} out of "
+                f"restart budget")
             for ind, w in list(workers.items()):
                 print(f"[fleet] terminating healthy actor-{ind} "
                       "(host failing fast)")
@@ -340,6 +417,8 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
     if host_stop.is_set():
         print(f"[fleet] SIGTERM: preemption notice — terminating "
               f"{len(workers)} actors on this host")
+        host_recorder.record("sigterm-preemption", live=len(workers))
+        flight_recorder.dump_all("SIGTERM preemption notice (actor host)")
         for ind, w in list(workers.items()):
             w.terminate()
             w.join(10.0)

@@ -16,17 +16,27 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import queue as _queue
+import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from pytorch_distributed_tpu.utils import tracing
 from pytorch_distributed_tpu.utils.experience import Transition
 
 _CTX = mp.get_context("spawn")
 
 
 class QueueFeeder:
-    """Actor-side feed endpoint; matches the memory ``feed`` surface."""
+    """Actor-side feed endpoint; matches the memory ``feed`` surface.
+
+    Every flushed chunk is a ``tracing.TracedChunk`` — a list subclass
+    carrying a trace id + birth wall-clock across the queue (or, via
+    RemoteMemory, the DCN wire), so downstream drains can record per-hop
+    latency.  With a tracer attached (``set_tracer``; the actor harness
+    binds its role tracer) the flush itself records an ``enqueue`` span —
+    a blocking put IS backpressure, and its duration is the measurement.
+    """
 
     def __init__(self, q, chunk: int = 16):
         self._q = q
@@ -34,6 +44,7 @@ class QueueFeeder:
         self._buf: List[Tuple[Transition, Optional[float]]] = []
         self._stop = None
         self._timeout_put = False
+        self._tracer: Optional[tracing.Tracer] = None
 
     def clone(self) -> "QueueFeeder":
         """Same queue, fresh chunk buffer — thread-backend workers each get
@@ -43,6 +54,17 @@ class QueueFeeder:
         if self._stop is not None:
             f.set_stop(self._stop)
         return f
+
+    def set_tracer(self, tracer) -> None:
+        """Attach the owning role's span recorder (utils/tracing.py)."""
+        self._tracer = tracer
+
+    def __getstate__(self):
+        # tracers hold threading locks: never ride a spawn pickle — the
+        # child attaches its own role tracer after unpickling
+        d = self.__dict__.copy()
+        d["_tracer"] = None
+        return d
 
     def set_stop(self, event) -> None:
         """Make flush() abort (dropping its buffer) once ``event`` is set:
@@ -77,30 +99,47 @@ class QueueFeeder:
     def flush(self) -> None:
         if not self._buf:
             return
+        traced = tracing.active()  # TPU_APEX_TRACE=0: plain list, no
+        chunk = (tracing.TracedChunk(self._buf)  # mint, no wire columns
+                 if traced else self._buf)
+        t0 = time.perf_counter()
+        delivered = True
         if self._stop is None or not self._timeout_put:
-            self._q.put(self._buf)
+            self._q.put(chunk)
         else:
             while True:
                 if self._stop.is_set():
+                    delivered = False
                     break  # shutdown: leftover experience is garbage
                 try:
-                    self._q.put(self._buf, timeout=0.2)
+                    self._q.put(chunk, timeout=0.2)
                     break
                 except _queue.Full:
                     continue
+        if traced and delivered and self._tracer is not None:
+            self._tracer.record("enqueue",
+                                (time.perf_counter() - t0) * 1e3,
+                                trace_id=chunk.trace_id)
         self._buf = []
 
 
 def pop_chunks(q, max_chunks: int = 1024) -> List[Tuple[Transition,
                                                         Optional[float]]]:
     """Drain pending (transition, priority) items from a feeder queue —
-    the single queue-pop loop every single-owner memory shares."""
+    the single queue-pop loop every single-owner memory shares.  Chunks
+    that arrive as TracedChunks record their queue-transit latency as a
+    ``feed`` span on the drain side (the replay plane's hop of the
+    actor→learner trace)."""
     out: List[Tuple[Transition, Optional[float]]] = []
+    tracer = tracing.get_tracer("feeder")
     for _ in range(max_chunks):
         try:
-            out.extend(q.get_nowait())
+            chunk = q.get_nowait()
         except _queue.Empty:
             break
+        if isinstance(chunk, tracing.TracedChunk):
+            tracer.record_hop("feed", chunk.born, chunk.trace_id)
+        out.extend(chunk)
     return out
 
 

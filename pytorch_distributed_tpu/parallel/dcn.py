@@ -67,6 +67,16 @@ Client-side adapters (``RemoteMemory``, ``RemoteParamStore``,
 ``RemoteClock``, ``RemoteStats``) present the exact surfaces the actor
 harness binds to (agents/actor.py), so ``run_dqn_actor``/``run_ddpg_actor``
 run unmodified on a remote host.
+
+Observability (utils/tracing.py, utils/flight_recorder.py): EXP frames
+carry the chunk's trace id + birth wall-clock as savez columns, so the
+gateway records the actor→gateway wire hop against the same trace the
+learner-side drain continues; session transitions (claims, fences,
+releases, reconnects, terminal losses) land in per-role flight-recorder
+rings dumped to ``blackbox/`` on abnormal exits.  The ``T_STATUS`` verb
+answers a live health snapshot — slot/incarnation/heartbeat-age states
+plus topology-provided replay/queue/budget/rate fields — to sessionless
+probes (``fetch_status``; rendered by tools/fleet_top.py).
 """
 
 from __future__ import annotations
@@ -84,6 +94,7 @@ import numpy as np
 
 from pytorch_distributed_tpu.agents.param_store import ParamStore
 from pytorch_distributed_tpu.memory.feeder import QueueFeeder
+from pytorch_distributed_tpu.utils import flight_recorder, tracing
 from pytorch_distributed_tpu.utils.experience import Transition
 from pytorch_distributed_tpu.utils.faults import FaultInjector
 
@@ -101,6 +112,7 @@ T_CLOCK = 5    # JSON {learner_step, stop}
 T_TICK = 6     # JSON {actor_steps, stats?, seq?}    -> T_CLOCK
 T_BYE = 7      # empty                               -> (close)
 T_PING = 8     # empty heartbeat                     -> T_CLOCK
+T_STATUS = 9   # empty -> T_STATUS JSON health snapshot (no HELLO needed)
 
 _MAX_FRAME = 1 << 31  # 2 GiB — far above any chunk; rejects garbage lengths
 
@@ -142,12 +154,18 @@ _FIELDS = ("state0", "action", "reward", "gamma_n", "state1", "terminal1")
 
 def encode_chunk(items: List[Tuple[Transition, Optional[float]]]) -> bytes:
     """Stack a chunk of (transition, priority) into one savez payload.
-    ``priority`` None (uniform / new-sample-max semantics) encodes as NaN."""
+    ``priority`` None (uniform / new-sample-max semantics) encodes as NaN.
+    A ``tracing.TracedChunk`` carries its trace id + birth wall-clock as
+    two extra columns (still no pickle on the wire), so the trace minted
+    at the actor survives the hop to the gateway."""
     cols = {f: np.stack([np.asarray(getattr(t, f)) for t, _ in items])
             for f in _FIELDS}
     cols["priority"] = np.array(
         [np.nan if p is None else float(p) for _, p in items],
         dtype=np.float32)
+    if isinstance(items, tracing.TracedChunk):
+        cols["trace_id"] = np.array([items.trace_id], dtype=np.uint64)
+        cols["trace_born"] = np.array([items.born], dtype=np.float64)
     out = io.BytesIO()
     np.savez(out, **cols)
     return out.getvalue()
@@ -163,6 +181,10 @@ def decode_chunk(payload: bytes
         t = Transition(*(cols[f][i] for f in _FIELDS))
         p = cols["priority"][i]
         items.append((t, None if np.isnan(p) else float(p)))
+    if "trace_id" in cols:  # re-wrap: the trace continues past the wire
+        return tracing.TracedChunk(items,
+                                   trace_id=int(cols["trace_id"][0]),
+                                   born=float(cols["trace_born"][0]))
     return items
 
 
@@ -190,7 +212,8 @@ class DcnGateway:
                  host: str = "0.0.0.0", port: int = 0,
                  local_actors: int = 0,
                  idle_deadline: Optional[float] = None,
-                 faults: Optional[FaultInjector] = None):
+                 faults: Optional[FaultInjector] = None,
+                 health: Optional[Callable[[], dict]] = None):
         self.param_store = param_store
         self.clock = clock
         self.actor_stats = actor_stats
@@ -200,6 +223,13 @@ class DcnGateway:
                                if idle_deadline is None else idle_deadline)
         self._faults = (faults if faults is not None
                         else FaultInjector.from_env("gateway"))
+        # extra STATUS fields from the owning topology (replay fill,
+        # queue depth, restart budget, learner rate — things only the
+        # learner-host wiring can see); called per STATUS request
+        self._health = health
+        self._tracer = tracing.get_tracer("gateway")
+        self._recorder = flight_recorder.get_recorder("gateway")
+        self._born = time.monotonic()
         self._srv = socket.create_server((host, port))
         self._srv.settimeout(0.25)
         self.port = self._srv.getsockname()[1]
@@ -207,10 +237,12 @@ class DcnGateway:
         self._threads: List[threading.Thread] = []
         self._slots: Dict[int, Tuple[int, socket.socket]] = {}
         self._tick_seq: Dict[int, int] = {}  # per-slot dedup high-water
+        self._last_seen: Dict[int, float] = {}  # slot -> last frame (mono)
         self._slots_lock = threading.Lock()
         self._conns: Set[socket.socket] = set()
         self.connections = 0
         self.chunks_in = 0
+        self.status_served = 0
         self.fenced = 0  # stale predecessors evicted by higher incarnations
         # all state above must exist before the first connection lands
         self._accept_thread = threading.Thread(
@@ -250,6 +282,42 @@ class DcnGateway:
         with self._slots_lock:
             return {s: inc for s, (inc, _c) in self._slots.items()}
 
+    def status_snapshot(self) -> dict:
+        """The live health plane's one read: slot states + incarnations +
+        heartbeat ages, clocks, gateway counters, and whatever the owning
+        topology's ``health`` provider adds (replay fill, ingest queue
+        depth, restart budget, learner step rate).  Slot fields are taken
+        under the registry lock so the snapshot is internally consistent;
+        the health extras are best-effort reads of a live system."""
+        now = time.monotonic()
+        with self._slots_lock:
+            slots = {
+                str(s): {
+                    "incarnation": inc,
+                    "heartbeat_age": round(
+                        now - self._last_seen.get(s, now), 3),
+                }
+                for s, (inc, _c) in self._slots.items()
+            }
+        snap = {
+            "wall": time.time(),
+            "uptime": round(now - self._born, 3),
+            "learner_step": int(self.clock.learner_step.value),
+            "actor_step": int(self.clock.actor_step.value),
+            "stop": bool(self.clock.stop.is_set()),
+            "local_actors": self.local_actors,
+            "slots": slots,
+            "connections": self.connections,
+            "chunks_in": self.chunks_in,
+            "fenced": self.fenced,
+        }
+        if self._health is not None:
+            try:
+                snap.update(self._health() or {})
+            except Exception as e:  # noqa: BLE001 - health is best-effort
+                snap["health_error"] = repr(e)
+        return snap
+
     def _claim_slot(self, ind: Optional[int], incarnation: int,
                     conn: socket.socket) -> Optional[str]:
         """Register a remote actor's global slot; returns an error string
@@ -288,7 +356,10 @@ class DcnGateway:
                             f"(incarnation {incarnation} <= {held_inc})")
                 evict = held_conn
                 self.fenced += 1
+                self._recorder.record("fence", slot=ind,
+                                      old=held_inc, new=incarnation)
             self._slots[ind] = (incarnation, conn)
+            self._last_seen[ind] = time.monotonic()
         if evict is not None:
             # outside the lock: unblock the predecessor's serve thread;
             # its release is identity-checked so it cannot free OUR claim
@@ -296,6 +367,8 @@ class DcnGateway:
                 evict.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
+        self._recorder.record("slot-claimed", slot=ind,
+                              incarnation=incarnation)
         return None
 
     def _fresh_tick(self, slot: Optional[int], seq: Optional[int]) -> bool:
@@ -325,6 +398,8 @@ class DcnGateway:
             held = self._slots.get(slot)
             if held is not None and held[1] is conn:
                 del self._slots[slot]
+                self._recorder.record("slot-released", slot=slot,
+                                      incarnation=held[0])
 
     def _serve(self, conn: socket.socket, addr) -> None:
         slot: Optional[int] = None
@@ -334,9 +409,24 @@ class DcnGateway:
             with conn:
                 while not self._stop.is_set():
                     ftype, payload = _recv_frame(conn)
-                    payload = self._faults.frame(payload)
+                    if ftype != T_STATUS:
+                        # STATUS probes are outside the fault plane: a
+                        # monitor polling the gateway must neither shift a
+                        # deterministic drill's frame schedule nor absorb
+                        # a fault meant for session traffic
+                        payload = self._faults.frame(payload)
+                    if slot is not None:
+                        # plain GIL-atomic write: heartbeat-age reads in
+                        # status_snapshot tolerate a one-frame race
+                        self._last_seen[slot] = time.monotonic()
                     if ftype == T_BYE:
                         return
+                    elif ftype == T_STATUS:
+                        # health probe: answered before any HELLO — a
+                        # monitoring CLI must never consume an actor slot
+                        self.status_served += 1
+                        _send_frame(conn, T_STATUS, json.dumps(
+                            self.status_snapshot()).encode())
                     elif ftype == T_EXP:
                         try:
                             items = decode_chunk(payload)
@@ -347,6 +437,10 @@ class DcnGateway:
                             # connection — never feed garbage into replay
                             raise ConnectionError(
                                 f"undecodable EXP frame: {e!r}")
+                        if isinstance(items, tracing.TracedChunk):
+                            # actor flush -> gateway receipt: the wire hop
+                            self._tracer.record_hop("gateway", items.born,
+                                                    items.trace_id)
                         try:
                             self.put_chunk(items)
                         except ValueError:
@@ -461,9 +555,44 @@ def feed_queue_of(memory_handles) -> Callable[[list], None]:
         return _enqueue
 
     def _direct(items: list) -> None:
+        if isinstance(items, tracing.TracedChunk):
+            # multi-writer rings feed inline on the serve thread — the
+            # "feed" hop collapses into the gateway receipt, record it so
+            # the trace still closes for shared-ring memory types
+            tracing.get_tracer("feeder").record_hop(
+                "feed", items.born, items.trace_id)
         for t, p in items:
             learner_side.feed(t, p)
     return _direct
+
+
+# ---------------------------------------------------------------------------
+# health-plane client
+# ---------------------------------------------------------------------------
+
+def fetch_status(address: Tuple[str, int], timeout: float = 5.0) -> dict:
+    """One STATUS round-trip against a gateway — the read side of the
+    live health plane (tools/fleet_top.py).  Deliberately sessionless:
+    no HELLO, no slot claim, a fresh connection per probe so a monitor
+    keeps working across gateway restarts exactly when it matters most.
+    Raises ConnectionError/OSError when the gateway is unreachable."""
+    sock = socket.create_connection(address, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        _send_frame(sock, T_STATUS, b"")
+        rtype, payload = _recv_frame(sock)
+        if rtype != T_STATUS:
+            raise ConnectionError(
+                f"expected T_STATUS reply, got frame type {rtype}")
+        try:
+            return json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ConnectionError(f"undecodable STATUS reply: {e}")
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -543,6 +672,8 @@ class DcnClient:
         self._reconnect_timeout = (
             _env_float("DCN_RECONNECT_TIMEOUT", 30.0)
             if reconnect_timeout is None else reconnect_timeout)
+        self._recorder = flight_recorder.get_recorder(
+            f"dcn-client-{process_ind}")
         self._last_rpc = time.monotonic()
         deadline = time.monotonic() + connect_timeout
         delay = 0.1
@@ -601,6 +732,13 @@ class DcnClient:
         # (fleet._remote_actor_main reads the flag after close())
         if not self._closed:
             self.disconnected.set()
+            # the actor is about to exit EXIT_DISCONNECTED: leave the
+            # post-mortem NOW, while the session history is still in
+            # memory (utils/flight_recorder.py failure paths)
+            self._recorder.record("dcn-terminal", slot=self.process_ind,
+                                  why=why, reconnects=self.reconnects)
+            flight_recorder.dump_all(
+                f"DcnDisconnected slot {self.process_ind}: {why}")
         return DcnDisconnected(
             f"DCN session to {self.address} lost (slot "
             f"{self.process_ind}): {why}")
@@ -657,6 +795,9 @@ class DcnClient:
             self._configure(sock)  # restore the steady-state reply deadline
             self._sock = sock
             self.reconnects += 1
+            self._recorder.record("reconnect", slot=self.process_ind,
+                                  incarnation=self.incarnation,
+                                  count=self.reconnects)
             try:
                 self._handle_reply(rtype, rpayload)
             except DcnRefused as e:

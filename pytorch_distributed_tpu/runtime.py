@@ -53,7 +53,11 @@ def _child_main(role: str, agent_type: str, args: tuple) -> None:
     """Spawn trampoline: pin this child to the CPU backend *before* any JAX
     computation, then dispatch to the worker function.  Backends initialise
     lazily, so flipping the config here is safe even though modules were
-    imported during unpickling."""
+    imported during unpickling.
+
+    Also the crash boundary for the flight recorder: an exception escaping
+    the worker dumps this process's event rings to ``blackbox/`` BEFORE
+    re-raising — the supervisor's restart must not erase the evidence."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     # CPU-backend processes never use the persistent compile cache: the
     # CPU AOT loader can nondeterministically SIGABRT re-loaded
@@ -63,6 +67,13 @@ def _child_main(role: str, agent_type: str, args: tuple) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    from pytorch_distributed_tpu.utils import flight_recorder
+
+    opt = args[0]
+    flight_recorder.configure(opt.log_dir)
+    label = role
+    if role in ("actor", "evaluator") and len(args) > 2:
+        label = f"{role}-{args[2]}"
     jax.config.update("jax_compilation_cache_dir", None)
     if role == "evaluator":
         # The evaluator's batch-1 greedy episodes are bursty CPU work
@@ -78,7 +89,12 @@ def _child_main(role: str, agent_type: str, args: tuple) -> None:
                 os.nice(nice)
             except OSError:  # pragma: no cover - restricted environments
                 pass
-    get_worker(role, agent_type)(*args)
+    try:
+        get_worker(role, agent_type)(*args)
+    except BaseException as e:
+        flight_recorder.get_recorder(label).record("crash", error=repr(e))
+        flight_recorder.dump_all(f"{label} crashed: {e!r}")
+        raise
 
 
 class Topology:
@@ -95,6 +111,9 @@ class Topology:
         self.param_store = ParamStore(_count_params(opt, self.spec))
         self.handles = build_memory(opt, self.spec)
         self._workers: List[Any] = []
+        # populated by the process-backend monitor; the health plane
+        # (fleet.py STATUS provider) reads per-slot budget remaining
+        self._restart_budget = None
         # set when a SIGTERM (preemption notice) ended the run rather
         # than the step budget — observable by callers/tests
         self.preempted = threading.Event()
@@ -142,6 +161,11 @@ class Topology:
         assert backend in ("process", "thread")
         opt = self.opt
         prebuild_native(opt)  # once, before N workers race the same g++
+        from pytorch_distributed_tpu.utils import flight_recorder
+
+        # the run's blackbox home; exported so spawn children inherit it
+        # without plumbing (same trick the fault schedules use)
+        flight_recorder.configure(opt.log_dir, export_env=True)
         prev_term = None
         run_over = threading.Event()
         if threading.current_thread() is threading.main_thread():
@@ -170,6 +194,10 @@ class Topology:
                             print("[runtime] SIGTERM: preemption notice "
                                   "— draining for a final checkpoint "
                                   "epoch", flush=True)
+                            flight_recorder.get_recorder("runtime").record(
+                                "sigterm-preemption")
+                            flight_recorder.dump_all(
+                                "SIGTERM preemption notice")
                             self.clock.stop.set()
                             return
 
@@ -247,11 +275,16 @@ class Topology:
         trips the stop event so the run fails fast instead of degrading
         silently.  Restart/GRACE policy shared with the fleet actor-host
         supervisor via utils/supervision.RestartBudget."""
+        from pytorch_distributed_tpu.utils import flight_recorder
         from pytorch_distributed_tpu.utils.supervision import (
             RestartBudget, describe_exit,
         )
 
+        recorder = flight_recorder.get_recorder("runtime")
         budget = RestartBudget(max_restarts=max_restarts)
+        # exposed for the health plane: the fleet gateway's STATUS verb
+        # reports per-slot restart budget remaining from here
+        self._restart_budget = budget
         for _p, role, ind, _args in self._proc_meta:
             # record first incarnations: the grace-period budget reset
             # only applies to slots with a KNOWN long-lived incarnation
@@ -269,12 +302,20 @@ class Topology:
                     print(f"[runtime] actor-{ind} died "
                           f"({describe_exit(p.exitcode)}); restart "
                           f"{budget.count(ind)}/{max_restarts}")
+                    recorder.record("worker-restarted", role=role,
+                                    slot=ind, exit=p.exitcode,
+                                    restarts=budget.count(ind))
                     self._workers.remove(p)
                     self._proc_meta.remove((p, role, ind, args))
                     self._spawn(role, ind, args)
                 else:
                     print(f"[runtime] {role}-{ind} died "
                           f"({describe_exit(p.exitcode)}); stopping run")
+                    recorder.record("worker-fatal", role=role, slot=ind,
+                                    exit=p.exitcode)
+                    flight_recorder.dump_all(
+                        f"{role}-{ind} died "
+                        f"({describe_exit(p.exitcode)}); run stopped")
                     self.clock.stop.set()
                     return
             time.sleep(poll)

@@ -162,6 +162,28 @@ class FaultInjector:
 
     # -- the hook ------------------------------------------------------------
 
+    def _note(self, action: str, frame: int, fatal: bool) -> None:
+        """Leave the drill's fingerprint in the flight recorder
+        (utils/flight_recorder.py) — and for FATAL actions (crash, kill)
+        dump every ring this process holds NOW: nothing runs after a
+        SIGKILL, so the pre-signal dump is the only reason a kill drill
+        leaves a ``blackbox/`` post-mortem at all.  Transparent faults
+        (sever/delay/corrupt) only record: the session layer is expected
+        to ride through them, and a dump per routine sever would churn
+        the blackbox files of a healthy soak."""
+        try:
+            from pytorch_distributed_tpu.utils import flight_recorder
+
+            flight_recorder.get_recorder(
+                f"faults-{self.name or 'anon'}").record(
+                "fault", action=action, frame=frame)
+            if fatal:
+                flight_recorder.dump_all(
+                    f"injected {action} at frame {frame} "
+                    f"(faults:{self.name})")
+        except Exception:  # noqa: BLE001 - the drill must fire regardless
+            pass
+
     def frame(self, payload: bytes = b"") -> bytes:
         """Account one frame operation; fire its scheduled events."""
         with self._lock:
@@ -172,6 +194,7 @@ class FaultInjector:
             return payload
         for action, arg in events:
             self.injected += 1
+            self._note(action, n, fatal=action in ("crash", "kill"))
             if action == "delay":
                 time.sleep(arg)
             elif action == "sever":

@@ -1,0 +1,157 @@
+"""Flight recorder: a bounded in-memory ring of recent structured events
+per role, dumped to ``{log_dir}/blackbox/<role>.jsonl`` when something
+dies.
+
+After PR 1 (DCN reconnect/fencing) and PR 2 (crash-consistent epochs) the
+fleet survives faults it could not *explain*: when a chaos drill kills a
+slot or a checkpoint heals a torn epoch, the only evidence was grepping
+stdout.  This is the post-mortem layer: every role appends its last N
+structured events (session transitions, fault injections, supervisor
+decisions, span traffic) to a ring that costs one lock + deque append,
+and the ring is written out as JSONL — newest state wins, one file per
+role — on the paths where a run ends abnormally:
+
+- **crash** — runtime._child_main wraps every spawned worker; an escaping
+  exception dumps before re-raising, so the supervisor's restart does not
+  erase the evidence.
+- **SIGTERM preemption** — runtime.py's preemption watcher and fleet.py's
+  actor-host handler dump before draining.
+- **DcnDisconnected** — parallel/dcn.py DcnClient dumps when it latches a
+  terminal session loss (the actor is about to exit EXIT_DISCONNECTED).
+- **injected faults** — utils/faults.py records every fired event and
+  dumps on the fatal ones; ``kill@N`` dumps *before* the SIGKILL, which
+  is the only reason a SIGKILL drill leaves an artifact at all (nothing
+  can run after the signal).
+
+The dump directory is set once per process via ``configure(log_dir)``;
+the orchestrator also exports ``TPU_APEX_BLACKBOX_DIR`` so spawn children
+inherit it without plumbing (the same trick utils/faults.py uses for
+fault schedules).  Unconfigured processes never write anything — library
+users don't get surprise ``blackbox/`` litter.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_ENV_DIR = "TPU_APEX_BLACKBOX_DIR"
+
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """One role's bounded event ring.  ``record`` is the hot-path call:
+    one lock + deque append (the deque's maxlen discards the oldest)."""
+
+    def __init__(self, role: str, capacity: int = DEFAULT_CAPACITY):
+        self.role = role
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self.recorded = 0  # lifetime count (ring only keeps the tail)
+
+    def record(self, kind: str, **fields) -> None:
+        evt = {"t": time.time(), "kind": kind}
+        evt.update(fields)
+        with self._lock:
+            self._ring.append(evt)
+            self.recorded += 1
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, log_dir: Optional[str] = None,
+             reason: str = "") -> Optional[str]:
+        """Write the ring to ``{log_dir}/blackbox/{role}.jsonl``; returns
+        the path, or None when no dump dir is known.  Truncate-write: a
+        later dump supersedes an earlier one — the post-mortem wants the
+        final state, and each file is one role's whole story."""
+        target = log_dir or _dump_dir()
+        if not target:
+            return None
+        events = self.snapshot()
+        blackbox = os.path.join(target, "blackbox")
+        path = os.path.join(blackbox, f"{_safe_name(self.role)}.jsonl")
+        try:
+            os.makedirs(blackbox, exist_ok=True)
+            with open(path, "w") as f:
+                f.write(json.dumps({
+                    "t": time.time(), "kind": "dump", "role": self.role,
+                    "reason": reason, "pid": os.getpid(),
+                    "events": len(events),
+                    "recorded_total": self.recorded,
+                }) + "\n")
+                for evt in events:
+                    f.write(json.dumps(evt) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            # dumping is last-rites best-effort: a full disk must not
+            # turn a clean SIGTERM drain into a crash
+            return None
+        return path
+
+
+# ---------------------------------------------------------------------------
+# per-process registry + dump plumbing
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_recorders: Dict[str, FlightRecorder] = {}
+_configured_dir: Optional[str] = None
+
+
+def _safe_name(role: str) -> str:
+    return "".join(c if (c.isalnum() or c in "-_.") else "_"
+                   for c in role) or "role"
+
+
+def _dump_dir() -> Optional[str]:
+    return _configured_dir or os.environ.get(_ENV_DIR) or None
+
+
+def configure(log_dir: str, export_env: bool = False) -> None:
+    """Set this process's dump directory.  ``export_env=True`` also
+    exports it so spawn children inherit (orchestrators only — a child
+    must not clobber what its parent exported)."""
+    global _configured_dir
+    _configured_dir = log_dir
+    if export_env:
+        os.environ[_ENV_DIR] = log_dir
+
+
+def get_recorder(role: str,
+                 capacity: int = DEFAULT_CAPACITY) -> FlightRecorder:
+    with _lock:
+        rec = _recorders.get(role)
+        if rec is None:
+            rec = _recorders[role] = FlightRecorder(role, capacity)
+        return rec
+
+
+def dump_all(reason: str = "",
+             log_dir: Optional[str] = None) -> List[str]:
+    """Dump every recorder this process holds; returns written paths.
+    Safe on any path — including signal-adjacent ones — because it only
+    appends files under an existing log dir and swallows I/O errors."""
+    with _lock:
+        recs = list(_recorders.values())
+    paths = []
+    for rec in recs:
+        p = rec.dump(log_dir=log_dir, reason=reason)
+        if p:
+            paths.append(p)
+    return paths
+
+
+def reset() -> None:
+    """Drop all recorders and the configured dir (test isolation)."""
+    global _configured_dir
+    with _lock:
+        _recorders.clear()
+    _configured_dir = None

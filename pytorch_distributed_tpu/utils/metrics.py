@@ -9,19 +9,56 @@ x-axis for everything.  Here the writer is a small append-only JSONL sink
 so existing dashboards carry over (``evaluator/avg_reward``,
 ``actor/total_nframes``, ``learner/critic_loss``, ... — reference
 dqn_logger.py:23-55).
+
+Three row kinds share ``scalars.jsonl`` (discriminated by ``kind``,
+scalars carry none for backward compatibility):
+
+- scalar     — ``{tag, value, step, wall}``
+- histogram  — ``{tag, kind: "histogram", count, mean, p50, p95, max,
+  step, wall}``: a distribution summarized at the WRITER (utils/tracing.py
+  span reservoirs land here); percentiles, not just means, because stalls
+  live in the tail.
+- span       — ``{tag, kind: "span", span, role, trace_id, value, step,
+  wall}``: one sampled distributed-trace event (JSONL only — per-event
+  TensorBoard points would drown the dashboards).
+
+Every row is stamped with ``role`` and ``run_id`` when the writer knows
+them, so merging the JSONL streams of a multi-role/multi-host run never
+relies on directory layout.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
-from typing import Optional
+from typing import Dict, List, Optional, Sequence
+
+
+def summarize_histogram(values: Sequence[float]) -> Dict[str, float]:
+    """count/mean/p50/p95/max of a sample set.  Nearest-rank percentiles
+    (no interpolation): deterministic, and an observed-value answer —
+    "the p95 enqueue was THIS put" — which is what latency forensics
+    wants."""
+    vals = sorted(float(v) for v in values)
+    n = len(vals)
+    if n == 0:
+        raise ValueError("summarize_histogram of an empty sample")
+
+    def pct(q: float) -> float:
+        return vals[min(n - 1, max(0, math.ceil(q * n) - 1))]
+
+    return {"count": n, "mean": sum(vals) / n,
+            "p50": pct(0.50), "p95": pct(0.95), "max": vals[-1]}
 
 
 class MetricsWriter:
-    def __init__(self, log_dir: str, enable_tensorboard: bool = True):
+    def __init__(self, log_dir: str, enable_tensorboard: bool = True,
+                 role: Optional[str] = None, run_id: Optional[str] = None):
         self.log_dir = log_dir
+        self.role = role
+        self.run_id = run_id
         os.makedirs(log_dir, exist_ok=True)
         self._jsonl = open(os.path.join(log_dir, "scalars.jsonl"), "a",
                            buffering=1)
@@ -34,11 +71,21 @@ class MetricsWriter:
             except Exception:  # noqa: BLE001 - TB is best-effort
                 self._tb = None
 
+    def _write(self, rec: dict) -> None:
+        # setdefault: a row carrying its own attribution (e.g. a span
+        # recorded by the gateway but flushed by the learner's writer)
+        # keeps it
+        if self.role is not None:
+            rec.setdefault("role", self.role)
+        if self.run_id is not None:
+            rec.setdefault("run_id", self.run_id)
+        self._jsonl.write(json.dumps(rec) + "\n")
+
     def scalar(self, tag: str, value: float, step: int,
                wall: Optional[float] = None) -> None:
         rec = {"tag": tag, "value": float(value), "step": int(step),
                "wall": wall if wall is not None else time.time()}
-        self._jsonl.write(json.dumps(rec) + "\n")
+        self._write(rec)
         if self._tb is not None:
             # explicit walltime: TB's wall-clock view must show the same
             # capture-true timestamps the JSONL rows carry
@@ -52,6 +99,37 @@ class MetricsWriter:
         for tag, value in kv.items():
             self.scalar(tag, value, step, wall)
 
+    def histogram(self, tag: str, values: Sequence[float], step: int,
+                  wall: Optional[float] = None,
+                  count: Optional[int] = None) -> None:
+        """One summarized-distribution row (p50/p95/max, not just the
+        mean); mirrored to TensorBoard as ``<tag>/p50|p95|max`` scalars
+        so tail latency is a dashboard read.  ``count`` overrides the
+        reported event count when ``values`` is a bounded reservoir of a
+        larger population (utils/tracing.py Tracer reservoirs)."""
+        if not values:
+            return
+        s = summarize_histogram(values)
+        rec = {"tag": tag, "kind": "histogram", "step": int(step),
+               "wall": wall if wall is not None else time.time()}
+        rec.update(s)
+        if count is not None:
+            rec["count"] = int(count)
+        self._write(rec)
+        if self._tb is not None:
+            for k in ("p50", "p95", "max"):
+                self._tb.add_scalar(f"{tag}/{k}", float(s[k]), int(step),
+                                    walltime=rec["wall"])
+
+    def span(self, span: str, role: str, trace_id: str, dur_ms: float,
+             step: int = 0, wall: Optional[float] = None) -> None:
+        """One sampled distributed-trace event (utils/tracing.py).  JSONL
+        only — per-event TB points would drown the dashboards."""
+        self._write({"tag": f"trace/{role}/{span}", "kind": "span",
+                     "span": span, "role": role, "trace_id": trace_id,
+                     "value": float(dur_ms), "step": int(step),
+                     "wall": wall if wall is not None else time.time()})
+
     def flush(self) -> None:
         self._jsonl.flush()
         if self._tb is not None:
@@ -64,10 +142,23 @@ class MetricsWriter:
             self._tb.close()
 
 
-def read_scalars(log_dir: str):
-    """Load all JSONL scalar records from a run dir (tests/bench use this)."""
+def read_scalars(log_dir: str) -> List[dict]:
+    """Load all JSONL records from a run dir (tests/bench/tools use this).
+    A SIGKILL mid-write leaves a torn trailing line — skip undecodable
+    lines instead of raising, matching the torn-artifact philosophy of
+    the checkpoint tier (utils/checkpoint.py: a torn epoch is skipped,
+    never fatal)."""
     path = os.path.join(log_dir, "scalars.jsonl")
     if not os.path.exists(path):
         return []
+    out = []
     with open(path) as f:
-        return [json.loads(line) for line in f if line.strip()]
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # torn line (kill mid-write); the rest is good
+    return out

@@ -12,6 +12,11 @@ The reference has none — only stdout banners and TensorBoard scalars
   a real XLA trace (TensorBoard-viewable) for a bounded window, gated so it
   can be left in production code and switched on with an env var
   (``TPU_APEX_PROFILE=dir``).
+
+Cross-role request tracing (per-hop trace ids + latency histograms) lives
+in utils/tracing.py; the post-mortem event rings in
+utils/flight_recorder.py.  README "Observability" documents all three
+together with the env knobs.
 """
 
 from __future__ import annotations
@@ -24,11 +29,15 @@ from typing import Dict, Iterator, Optional
 
 class StepTimer:
     """Accumulates wall seconds per named phase; drain() returns and resets
-    {phase: (seconds, calls)} as flat metrics."""
+    per-phase mean/max/call-count as flat metrics.  The max and count ride
+    along because a mean averages stalls away: one 2 s drain in a window
+    of 100 × 2 ms drains reads as 22 ms mean — the ``*_max_ms`` row is
+    what makes the stall visible."""
 
     def __init__(self, prefix: str):
         self.prefix = prefix
         self._acc: Dict[str, float] = {}
+        self._max: Dict[str, float] = {}
         self._n: Dict[str, int] = {}
 
     @contextlib.contextmanager
@@ -39,6 +48,8 @@ class StepTimer:
         finally:
             dt = time.perf_counter() - t0
             self._acc[name] = self._acc.get(name, 0.0) + dt
+            if dt > self._max.get(name, 0.0):
+                self._max[name] = dt
             self._n[name] = self._n.get(name, 0) + 1
 
     def drain(self) -> Dict[str, float]:
@@ -46,7 +57,11 @@ class StepTimer:
         for name, secs in self._acc.items():
             n = self._n[name]
             out[f"{self.prefix}/time_{name}_ms"] = secs / max(n, 1) * 1e3
+            out[f"{self.prefix}/time_{name}_max_ms"] = \
+                self._max.get(name, 0.0) * 1e3
+            out[f"{self.prefix}/time_{name}_calls"] = float(n)
         self._acc.clear()
+        self._max.clear()
         self._n.clear()
         return out
 

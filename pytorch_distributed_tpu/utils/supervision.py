@@ -59,6 +59,12 @@ class RestartBudget:
     def count(self, slot: int) -> int:
         return self._restarts.get(slot, 0)
 
+    def remaining(self) -> Dict[int, int]:
+        """Per-slot restarts left, for every slot ever born — the health
+        plane's view (DCN STATUS verb / tools/fleet_top.py)."""
+        return {slot: max(0, self.max_restarts - self._restarts.get(slot, 0))
+                for slot in self._born}
+
     def request_restart(self, slot: int) -> Optional[float]:
         born = self._born.get(slot)
         # only a RECORDED incarnation that outlived the grace period

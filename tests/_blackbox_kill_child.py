@@ -1,0 +1,38 @@
+"""Child process for the flight-recorder SIGKILL drill
+(tests/test_observability.py TestFlightRecorder): records a stream of
+structured events into a bounded ring while a scripted fault injector
+(utils/faults.py) SIGKILLs the process at an exact frame — the injector's
+pre-signal ``dump_all`` (the only code that can run before a SIGKILL)
+must leave a digestible ``blackbox/<role>.jsonl`` post-mortem behind.
+Same pattern as tests/_ckpt_kill_child.py, aimed at the blackbox layer
+instead of the checkpoint store.
+
+Run: python _blackbox_kill_child.py <log_dir> <fault_spec>
+Prints ``DONE`` only if the schedule never fired (the drill asserts it
+does NOT appear).  No jax import — the drill is pure host code.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main() -> None:
+    log_dir, spec = sys.argv[1], sys.argv[2]
+
+    from pytorch_distributed_tpu.utils import flight_recorder
+    from pytorch_distributed_tpu.utils.faults import FaultInjector
+
+    flight_recorder.configure(log_dir)
+    recorder = flight_recorder.get_recorder("actor-0", capacity=64)
+    injector = FaultInjector.scripted(spec, name="blackbox-drill")
+    for i in range(10_000):
+        recorder.record("tick", i=i)
+        injector.frame(b"x")  # fires the schedule (kill@N dumps first)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,562 @@
+"""Observability plane drills: distributed trace propagation across a
+real in-process gateway, flight-recorder dumps on SIGKILL (the
+_blackbox_kill_child.py drill, same pattern as the checkpoint kill
+drills), STATUS snapshot consistency under the chaos harness, histogram
+percentile math, and smoke tests pinning tools/fleet_top.py and
+tools/plot_run.py against generated run dirs so the tools cannot
+silently rot."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+from pytorch_distributed_tpu.memory.feeder import QueueFeeder, QueueOwner
+from pytorch_distributed_tpu.parallel.dcn import (
+    DcnClient, DcnGateway, RemoteMemory, decode_chunk, encode_chunk,
+    feed_queue_of, fetch_status,
+)
+from pytorch_distributed_tpu.utils import flight_recorder, tracing
+from pytorch_distributed_tpu.utils.faults import FaultInjector, InjectedCrash
+from pytorch_distributed_tpu.utils.metrics import (
+    MetricsWriter, read_scalars, summarize_histogram,
+)
+from pytorch_distributed_tpu.utils.profiling import StepTimer
+from tools.chaos_soak import SyntheticActor, tagged_transition
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observability(monkeypatch):
+    """Tracers/recorders are per-process registries; isolate each test —
+    including the blackbox-dir env var an earlier in-process Topology.run
+    exported for its spawn children."""
+    monkeypatch.delenv("TPU_APEX_BLACKBOX_DIR", raising=False)
+    tracing.reset()
+    flight_recorder.reset()
+    yield
+    tracing.reset()
+    flight_recorder.reset()
+
+
+class _ListMemory:
+    """Minimal single-owner memory for QueueOwner in trace drills."""
+
+    capacity = 1 << 16
+
+    def __init__(self):
+        self.items = []
+
+    def feed(self, transition, priority=None):
+        self.items.append((transition, priority))
+
+    @property
+    def size(self):
+        return len(self.items)
+
+
+def _drain_until(owner, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while owner.size < n:
+        assert time.monotonic() < deadline, \
+            f"only {owner.size}/{n} transitions drained"
+        owner.drain()
+        time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# histogram percentile math (utils/metrics.py satellite)
+# ---------------------------------------------------------------------------
+
+class TestHistogramMath:
+    def test_nearest_rank_percentiles(self):
+        s = summarize_histogram(list(range(1, 101)))  # 1..100
+        assert s == {"count": 100, "mean": 50.5,
+                     "p50": 50, "p95": 95, "max": 100}
+
+    def test_order_invariant_and_small_samples(self):
+        assert summarize_histogram([9.0]) == {
+            "count": 1, "mean": 9.0, "p50": 9.0, "p95": 9.0, "max": 9.0}
+        a = summarize_histogram([3.0, 1.0, 2.0])
+        assert (a["p50"], a["p95"], a["max"]) == (2.0, 3.0, 3.0)
+        with pytest.raises(ValueError):
+            summarize_histogram([])
+
+    def test_writer_emits_stamped_histogram_row(self, tmp_path):
+        w = MetricsWriter(str(tmp_path), enable_tensorboard=False,
+                          role="learner", run_id="run-7")
+        w.histogram("trace/learner/learn_ms", [1.0, 2.0, 100.0], step=42)
+        w.scalar("learner/critic_loss", 0.5, step=42)
+        w.close()
+        rows = read_scalars(str(tmp_path))
+        hist = [r for r in rows if r.get("kind") == "histogram"]
+        assert len(hist) == 1
+        h = hist[0]
+        assert h["p50"] == 2.0 and h["max"] == 100.0 and h["count"] == 3
+        # every row — scalar and histogram alike — carries role + run_id
+        for r in rows:
+            assert r["role"] == "learner" and r["run_id"] == "run-7"
+
+
+class TestTornJsonl:
+    def test_read_scalars_skips_torn_trailing_line(self, tmp_path):
+        path = tmp_path / "scalars.jsonl"
+        good = [{"tag": "a", "value": 1.0, "step": 1, "wall": 2.0},
+                {"tag": "b", "value": 2.0, "step": 2, "wall": 3.0}]
+        with open(path, "w") as f:
+            for r in good:
+                f.write(json.dumps(r) + "\n")
+            # SIGKILL mid-write: a partial JSON object, no newline
+            f.write('{"tag": "c", "val')
+        rows = read_scalars(str(tmp_path))
+        assert rows == good  # torn tail skipped, never raised
+
+
+class TestStepTimer:
+    def test_drain_reports_mean_max_and_calls(self):
+        t = StepTimer("x")
+        for pause in (0.001, 0.02):
+            with t.phase("p"):
+                time.sleep(pause)
+        out = t.drain()
+        assert out["x/time_p_calls"] == 2.0
+        # the stall is visible in max, averaged down in mean
+        assert out["x/time_p_max_ms"] >= 20.0 * 0.5  # timer slop margin
+        assert out["x/time_p_max_ms"] >= out["x/time_p_ms"]
+        assert t.drain() == {}  # drain resets everything, max included
+
+
+# ---------------------------------------------------------------------------
+# distributed trace propagation (tentpole part 1)
+# ---------------------------------------------------------------------------
+
+class TestTracePropagation:
+    def test_chunk_trace_survives_the_wire_encoding(self):
+        chunk = tracing.TracedChunk(
+            [(tagged_transition(5), 0.5)], trace_id=1234, born=99.5)
+        out = decode_chunk(encode_chunk(chunk))
+        assert isinstance(out, tracing.TracedChunk)
+        assert out.trace_id == 1234 and out.born == 99.5
+        # plain lists stay plain: wire format is backward compatible
+        assert not isinstance(
+            decode_chunk(encode_chunk([(tagged_transition(5), None)])),
+            tracing.TracedChunk)
+
+    def test_end_to_end_trace_across_real_gateway(self, tmp_path):
+        """The acceptance chain: an actor-side feeder mints a trace id,
+        the id rides the DCN wire and the learner-side spawn queue, and
+        the enqueue/gateway/feed/learn spans all land in the metrics
+        stream sharing that id, with histogram percentiles."""
+        clock, stats = GlobalClock(), ActorStats()
+        store = ParamStore(8)
+        store.publish(np.zeros(8, dtype=np.float32))
+        owner = QueueOwner(_ListMemory())
+        handles = types.SimpleNamespace(learner_side=owner)
+        gw = DcnGateway(store, clock, stats,
+                        put_chunk=feed_queue_of(handles),
+                        host="127.0.0.1", port=0)
+        client = DcnClient(("127.0.0.1", gw.port), process_ind=0,
+                           heartbeat_interval=0)
+        try:
+            memory = RemoteMemory(client, chunk=4)
+            memory.set_tracer(tracing.get_tracer("actor"))
+            for i in range(4):
+                memory.feed(tagged_transition(i), None)  # flushes at 4
+            _drain_until(owner, 4)
+            # learner tail: sample/learn attach to the drained trace
+            with tracing.get_tracer("learner").span(
+                    "learn", trace_id=tracing.current_trace()):
+                pass
+            writer = MetricsWriter(str(tmp_path),
+                                   enable_tensorboard=False,
+                                   role="learner", run_id="trace-run")
+            for role in ("actor", "gateway", "feeder", "learner"):
+                tracing.get_tracer(role).flush_to(writer, step=7)
+            writer.close()
+        finally:
+            client.close()
+            gw.close()
+
+        rows = read_scalars(str(tmp_path))
+        spans = {r["span"]: r for r in rows if r.get("kind") == "span"}
+        assert set(spans) >= {"enqueue", "gateway", "feed", "learn"}
+        tids = {r["trace_id"] for r in spans.values()}
+        assert len(tids) == 1  # ONE end-to-end trace id across all hops
+        assert spans["enqueue"]["role"] == "actor"
+        assert spans["gateway"]["role"] == "gateway"
+        assert spans["feed"]["role"] == "feeder"
+        assert spans["learn"]["role"] == "learner"
+        hists = {r["tag"]: r for r in rows if r.get("kind") == "histogram"}
+        for tag in ("trace/actor/enqueue_ms", "trace/gateway/gateway_ms",
+                    "trace/feeder/feed_ms", "trace/learner/learn_ms"):
+            assert tag in hists
+            assert hists[tag]["p95"] >= hists[tag]["p50"] >= 0.0
+            assert hists[tag]["max"] >= hists[tag]["p95"]
+
+    def test_local_queue_path_traces_without_dcn(self):
+        """Single-host topologies trace too: the spawn-queue hop records
+        enqueue + feed spans with one id, no gateway involved."""
+        owner = QueueOwner(_ListMemory())
+        feeder = owner.make_feeder(chunk=2)
+        feeder.set_tracer(tracing.get_tracer("actor"))
+        feeder.feed(tagged_transition(0), None)
+        feeder.feed(tagged_transition(1), None)
+        _drain_until(owner, 2)
+        a_hist, a_rows, a_counts = tracing.get_tracer("actor").drain()
+        f_hist, f_rows, _f_counts = tracing.get_tracer("feeder").drain()
+        assert "enqueue" in a_hist and "feed" in f_hist
+        assert a_counts["enqueue"] == 1
+        assert a_rows[0]["trace_id"] == f_rows[0]["trace_id"]
+
+    def test_trace_kill_switch_ships_plain_lists(self, monkeypatch):
+        """TPU_APEX_TRACE=0 removes the whole per-chunk cost: no trace
+        id minted, nothing for downstream hops to record."""
+        monkeypatch.setenv("TPU_APEX_TRACE", "0")
+        owner = QueueOwner(_ListMemory())
+        feeder = owner.make_feeder(chunk=1)
+        feeder.set_tracer(tracing.get_tracer("actor"))
+        feeder.feed(tagged_transition(0), None)
+        _drain_until(owner, 1)
+        hist, _rows, _counts = tracing.get_tracer("feeder").drain()
+        assert hist == {}  # no TracedChunk ever crossed the queue
+
+    def test_tracer_disabled_records_nothing(self):
+        t = tracing.Tracer("off-role", enabled=False)
+        t.record("x", 1.0, trace_id=5)
+        with t.span("y", trace_id=6):
+            pass
+        hist, rows, counts = t.drain()
+        assert hist == {} and rows == [] and counts == {}
+
+    def test_sampling_thins_rows_but_not_histograms(self):
+        t = tracing.Tracer("sampled", enabled=True, sample=0.1)
+        for i in range(100):
+            t.record("s", 1.0, trace_id=i + 1)
+        hist, rows, counts = t.drain()
+        assert len(hist["s"]) == 100      # histograms see every event
+        assert counts["s"] == 100
+        assert 5 <= len(rows) <= 15       # rows are 1-in-10 sampled
+
+    def test_reservoir_keeps_true_count_and_samples_the_tail(self):
+        """Past MAX_SAMPLES the reservoir keeps an equal-probability
+        sample of the WHOLE window (a late stall can still reach the
+        percentiles) and the drained count reports every event."""
+        t = tracing.Tracer("busy", enabled=True, sample=0.0)
+        t.MAX_SAMPLES = 64
+        for _ in range(1000):
+            t.record("s", 1.0)
+        for _ in range(1000):  # the late half of the window
+            t.record("s", 9.0)
+        hist, _rows, counts = t.drain()
+        assert counts["s"] == 2000
+        assert len(hist["s"]) == 64
+        assert 9.0 in hist["s"]  # P(no late sample) = 0.5^64 — never
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (tentpole part 2)
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_dump_is_digestible(self, tmp_path):
+        rec = flight_recorder.FlightRecorder("actor-3", capacity=16)
+        for i in range(100):
+            rec.record("tick", i=i)
+        path = rec.dump(log_dir=str(tmp_path), reason="unit")
+        assert path is not None and path.endswith("blackbox/actor-3.jsonl")
+        with open(path) as f:
+            lines = [json.loads(line) for line in f]  # every line parses
+        header, events = lines[0], lines[1:]
+        assert header["kind"] == "dump" and header["reason"] == "unit"
+        assert header["recorded_total"] == 100
+        assert len(events) == 16  # the ring kept only the newest tail
+        assert [e["i"] for e in events] == list(range(84, 100))
+
+    def test_unconfigured_process_never_writes(self, tmp_path):
+        rec = flight_recorder.get_recorder("quiet")
+        rec.record("tick")
+        assert rec.dump(reason="no dir") is None
+        assert flight_recorder.dump_all("no dir") == []
+        assert not (tmp_path / "blackbox").exists()
+
+    def test_dump_on_sigkill_drill(self, tmp_path):
+        """The _ckpt_kill_child.py pattern aimed at the blackbox: the
+        child is SIGKILLed by a scripted fault at frame 37 and must still
+        leave a digestible post-mortem (the injector dumps pre-signal —
+        nothing can run after SIGKILL)."""
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tests", "_blackbox_kill_child.py"),
+             str(tmp_path), "kill@37"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == -signal.SIGKILL
+        assert "DONE" not in proc.stdout  # the drill really fired
+        path = tmp_path / "blackbox" / "actor-0.jsonl"
+        assert path.exists()
+        with open(path) as f:
+            lines = [json.loads(line) for line in f]
+        assert lines[0]["kind"] == "dump"
+        assert "kill" in lines[0]["reason"]
+        ticks = [e["i"] for e in lines if e["kind"] == "tick"]
+        assert ticks and ticks[-1] == 37  # events up to the kill point
+        # the injector's own ring recorded the fatal fault
+        faults_path = tmp_path / "blackbox" / "faults-blackbox-drill.jsonl"
+        assert faults_path.exists()
+        with open(faults_path) as f:
+            fault_lines = [json.loads(line) for line in f]
+        assert any(e.get("action") == "kill" for e in fault_lines)
+
+
+# ---------------------------------------------------------------------------
+# STATUS verb / live health plane (tentpole part 3)
+# ---------------------------------------------------------------------------
+
+class TestStatusPlane:
+    def _plane(self, **gw_kwargs):
+        clock, stats = GlobalClock(), ActorStats()
+        store = ParamStore(8)
+        store.publish(np.zeros(8, dtype=np.float32))
+        gw = DcnGateway(store, clock, stats,
+                        put_chunk=lambda items: None,
+                        host="127.0.0.1", port=0, **gw_kwargs)
+        return gw, clock
+
+    def test_status_is_sessionless_and_carries_health_fields(self):
+        gw, clock = self._plane(
+            health=lambda: {"replay_size": 7, "replay_capacity": 10})
+        try:
+            clock.set_learner_step(123)
+            status = fetch_status(("127.0.0.1", gw.port))
+            assert status["learner_step"] == 123
+            assert status["slots"] == {}
+            assert status["replay_size"] == 7
+            assert status["replay_capacity"] == 10
+            assert status["uptime"] >= 0
+            assert gw.active_slots == {}  # the probe claimed no slot
+        finally:
+            gw.close()
+
+    def test_health_provider_errors_degrade_not_crash(self):
+        def bad_health():
+            raise RuntimeError("replay not attached yet")
+
+        gw, _clock = self._plane(health=bad_health)
+        try:
+            status = fetch_status(("127.0.0.1", gw.port))
+            assert "health_error" in status
+            assert status["slots"] == {}  # core snapshot still served
+        finally:
+            gw.close()
+
+    def test_status_consistency_under_chaos(self):
+        """The chaos-harness consistency drill: a flowing fleet's STATUS
+        matches the gateway's own registry; after one role dies its slot
+        leaves the snapshot while the survivor keeps flowing."""
+        gw, clock = self._plane(idle_deadline=1.0)
+        fleet = [SyntheticActor(("127.0.0.1", gw.port), slot=i, pace=0.002,
+                                client_kwargs=dict(heartbeat_interval=0.2,
+                                                   reconnect_timeout=5.0)
+                                ).start()
+                 for i in range(2)]
+        try:
+            deadline = time.monotonic() + 10
+            while set(gw.active_slots) != {0, 1}:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            status = fetch_status(("127.0.0.1", gw.port))
+            # snapshot agrees with the registry, slot for slot
+            assert {int(s) for s in status["slots"]} == {0, 1}
+            for slot, info in status["slots"].items():
+                assert info["incarnation"] == gw.active_slots[int(slot)]
+                assert 0.0 <= info["heartbeat_age"] < 5.0
+            # one role dies (loop stopped, socket torn like a process
+            # death): its slot must leave the snapshot, the survivor stays
+            dead = fleet[0]
+            dead.client.stop.set()  # ends its loop (clean close follows)
+            dead.thread.join(10)
+            assert not dead.thread.is_alive()
+            deadline = time.monotonic() + 15
+            while 0 in gw.active_slots:  # BYE'd or idle-reaped at 1 s
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            status = fetch_status(("127.0.0.1", gw.port))
+            assert list(status["slots"]) == ["1"]
+            assert status["chunks_in"] > 0
+        finally:
+            clock.stop.set()
+            for a in fleet:
+                a.thread.join(10)
+            gw.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: chaos kill → STATUS + blackbox + e2e trace
+# ---------------------------------------------------------------------------
+
+class TestChaosKillAcceptance:
+    def test_killed_slot_leaves_status_blackbox_and_trace(self, tmp_path):
+        """ISSUE 3 acceptance: a fast-tier chaos drill kills one slot;
+        the surviving gateway answers STATUS consistently, the killed
+        role leaves a digestible blackbox dump, and one end-to-end trace
+        (actor→gateway→feeder→learner sharing an id) lands in the
+        metrics stream with histogram percentiles."""
+        flight_recorder.configure(str(tmp_path))
+        clock, stats = GlobalClock(), ActorStats()
+        store = ParamStore(8)
+        store.publish(np.zeros(8, dtype=np.float32))
+        owner = QueueOwner(_ListMemory())
+        handles = types.SimpleNamespace(learner_side=owner)
+        gw = DcnGateway(store, clock, stats,
+                        put_chunk=feed_queue_of(handles),
+                        host="127.0.0.1", port=0,
+                        health=lambda: {"replay_size": owner.size})
+
+        # the doomed role: an InjectedCrash fault kills its loop (the
+        # whole-process SIGKILL variant is TestFlightRecorder's drill)
+        doomed = DcnClient(("127.0.0.1", gw.port), process_ind=1,
+                           heartbeat_interval=0,
+                           faults=FaultInjector.scripted("crash@3",
+                                                         name="drill"))
+        doomed_rec = flight_recorder.get_recorder("actor-1")
+        doomed_rec.record("session-start")
+        # the surviving role: a real traced feeder
+        survivor = DcnClient(("127.0.0.1", gw.port), process_ind=0,
+                             heartbeat_interval=0)
+        try:
+            memory = RemoteMemory(survivor, chunk=4)
+            memory.set_tracer(tracing.get_tracer("actor"))
+            for i in range(4):
+                memory.feed(tagged_transition(i), None)
+            _drain_until(owner, 4)
+            with tracing.get_tracer("learner").span(
+                    "learn", trace_id=tracing.current_trace()):
+                pass
+
+            with pytest.raises(InjectedCrash):
+                for _ in range(8):  # frame 3 of the doomed client dies
+                    doomed.tick(actor_steps=1)
+            doomed_rec.record("crash", error="InjectedCrash")
+            flight_recorder.dump_all("actor-1 crashed (chaos drill)")
+            # a dead process's sockets close from the OS side; simulate
+            # that so the gateway frees the slot now, not at idle-reap
+            doomed._sock.close()
+
+            # 1) the surviving gateway answers STATUS consistently
+            deadline = time.monotonic() + 10
+            while 1 in gw.active_slots:  # the dead conn releases its slot
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            status = fetch_status(("127.0.0.1", gw.port))
+            assert list(status["slots"]) == ["0"]
+            assert (status["slots"]["0"]["incarnation"]
+                    == gw.active_slots[0])
+            assert status["replay_size"] == 4
+            assert status["chunks_in"] >= 1
+
+            # 2) the killed role left a digestible blackbox dump
+            path = tmp_path / "blackbox" / "actor-1.jsonl"
+            assert path.exists()
+            with open(path) as f:
+                lines = [json.loads(line) for line in f]
+            assert lines[0]["kind"] == "dump"
+            kinds = {e["kind"] for e in lines[1:]}
+            assert {"session-start", "crash"} <= kinds
+            # the drill's injector fingerprinted itself too
+            assert (tmp_path / "blackbox" / "faults-drill.jsonl").exists()
+
+            # 3) one end-to-end trace with histogram percentiles
+            writer = MetricsWriter(str(tmp_path),
+                                   enable_tensorboard=False,
+                                   role="learner", run_id="chaos-run")
+            for role in ("actor", "gateway", "feeder", "learner"):
+                tracing.get_tracer(role).flush_to(writer, step=1)
+            writer.close()
+            rows = read_scalars(str(tmp_path))
+            spans = [r for r in rows if r.get("kind") == "span"]
+            by_span = {r["span"]: r["trace_id"] for r in spans}
+            assert set(by_span) >= {"enqueue", "gateway", "feed", "learn"}
+            assert len({by_span[s] for s in
+                        ("enqueue", "gateway", "feed", "learn")}) == 1
+            hist_tags = {r["tag"] for r in rows
+                         if r.get("kind") == "histogram"}
+            assert {"trace/actor/enqueue_ms", "trace/gateway/gateway_ms",
+                    "trace/feeder/feed_ms",
+                    "trace/learner/learn_ms"} <= hist_tags
+        finally:
+            survivor.close()
+            doomed.close()
+            gw.close()
+
+
+# ---------------------------------------------------------------------------
+# CI/tooling smoke: the observability tools against generated run dirs
+# ---------------------------------------------------------------------------
+
+class TestToolsSmoke:
+    def test_fleet_top_json_against_live_gateway(self):
+        clock, stats = GlobalClock(), ActorStats()
+        store = ParamStore(8)
+        store.publish(np.zeros(8, dtype=np.float32))
+        gw = DcnGateway(store, clock, stats,
+                        put_chunk=lambda items: None,
+                        host="127.0.0.1", port=0,
+                        health=lambda: {"replay_size": 3})
+        try:
+            clock.set_learner_step(17)
+            proc = subprocess.run(
+                [sys.executable, os.path.join(_REPO, "tools",
+                                              "fleet_top.py"),
+                 f"127.0.0.1:{gw.port}", "--json"],
+                capture_output=True, text=True, timeout=60,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            assert proc.returncode == 0, proc.stderr
+            status = json.loads(proc.stdout)
+            assert status["learner_step"] == 17
+            assert status["replay_size"] == 3
+        finally:
+            gw.close()
+
+    def test_fleet_top_json_unreachable_gateway_exits_nonzero(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "fleet_top.py"),
+             "127.0.0.1:1", "--json", "--timeout", "2"],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 1
+        assert "unreachable" in proc.stderr
+
+    def test_plot_run_against_generated_run_dir(self, tmp_path):
+        pytest.importorskip("matplotlib")
+        writer = MetricsWriter(str(tmp_path), enable_tensorboard=False,
+                               role="logger", run_id="smoke")
+        wall = time.time()
+        for step in range(5):
+            writer.scalars({"evaluator/avg_reward": step * 1.0,
+                            "learner/critic_loss": 1.0 / (step + 1),
+                            "actor/total_nframes": step * 100.0},
+                           step=step, wall=wall + step)
+        # non-scalar rows must not break the plotter
+        writer.histogram("trace/learner/learn_ms", [1.0, 2.0], step=4)
+        writer.span("learn", role="learner", trace_id="ab" * 8,
+                    dur_ms=1.5, step=4)
+        writer.close()
+        out = tmp_path / "smoke.png"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "plot_run.py"),
+             str(tmp_path), "--out", str(out)],
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "MPLBACKEND": "Agg"})
+        assert proc.returncode == 0, proc.stderr
+        assert out.exists() and out.stat().st_size > 0

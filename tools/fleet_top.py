@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Live fleet health viewer over the DCN gateway's STATUS verb.
+
+``top`` for the Ape-X fleet: polls the learner host's gateway
+(parallel/dcn.py ``fetch_status`` — sessionless, no actor slot consumed)
+and renders slot states, incarnations, heartbeat ages, restart-budget
+remaining, replay fill / ingest-queue depth, and the learner step rate.
+
+Usage:
+    python tools/fleet_top.py HOST:PORT            # refresh loop (humans)
+    python tools/fleet_top.py HOST:PORT --json     # one snapshot (CI)
+    python tools/fleet_top.py HOST:PORT --interval 1
+
+One-shot ``--json`` prints the raw snapshot and exits 0 (nonzero when the
+gateway is unreachable) so orchestrators/CI can assert fleet health with
+``fleet_top ... --json | jq``.  The refresh loop reconnects every poll,
+so it keeps reporting across the gateway restarts it exists to observe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from pytorch_distributed_tpu.parallel.dcn import fetch_status  # noqa: E402
+
+
+def _fmt_age(age: Optional[float]) -> str:
+    if age is None:
+        return "-"
+    if age < 120:
+        return f"{age:.1f}s"
+    return f"{age / 60:.1f}m"
+
+
+def render(status: dict) -> str:
+    """One snapshot as a plain-text panel (no curses: works in any
+    terminal, and the --once output is diffable in CI logs)."""
+    lines: List[str] = []
+    step = status.get("learner_step", 0)
+    rate = status.get("learner_steps_per_sec")
+    lines.append(
+        f"fleet @ {time.strftime('%H:%M:%S', time.localtime(status.get('wall', time.time())))}"
+        f"   learner step {step}"
+        + (f" ({rate:g}/s)" if rate is not None else "")
+        + f"   actor steps {status.get('actor_step', 0)}"
+        + ("   [STOPPING]" if status.get("stop") else ""))
+    fill = status.get("replay_fill")
+    parts = []
+    if "replay_size" in status:
+        parts.append(f"replay {status['replay_size']}"
+                     + (f"/{status['replay_capacity']}"
+                        if "replay_capacity" in status else "")
+                     + (f" ({fill:.0%})" if fill is not None else ""))
+    if "ingest_queue_depth" in status:
+        parts.append(f"ingest queue {status['ingest_queue_depth']}"
+                     + (f"/{status['ingest_queue_bound']}"
+                        if status.get("ingest_queue_bound") else ""))
+    parts.append(f"gateway up {_fmt_age(status.get('uptime'))}"
+                 f" · conns {status.get('connections', 0)}"
+                 f" · chunks {status.get('chunks_in', 0)}"
+                 f" · fenced {status.get('fenced', 0)}")
+    lines.append("  " + "   ".join(parts))
+    slots = status.get("slots", {})
+    lines.append("")
+    lines.append(f"  {'slot':>6} {'incarnation':>16} {'heartbeat':>10}")
+    for slot in sorted(slots, key=lambda s: int(s)):
+        info = slots[slot]
+        lines.append(
+            f"  {slot:>6} {info.get('incarnation', 0):>16} "
+            f"{_fmt_age(info.get('heartbeat_age')):>10}")
+    if not slots:
+        lines.append("  (no remote slots connected)")
+    local = status.get("local_actors", 0)
+    if local:
+        # remote slots' restart budgets live on their own actor hosts;
+        # the gateway only sees the learner host's local supervision
+        budget = status.get("local_restart_budget_remaining")
+        lines.append(f"  + {local} local actor(s) on the learner host "
+                     "(not DCN-attached)"
+                     + (f", restart budget {budget}" if budget else ""))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/fleet_top.py",
+        description="live fleet health over the DCN STATUS verb")
+    ap.add_argument("gateway", help="learner host gateway as host:port")
+    ap.add_argument("--json", action="store_true",
+                    help="print one raw snapshot as JSON and exit "
+                         "(nonzero if the gateway is unreachable)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one panel and exit (no screen clearing)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period, seconds")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="per-probe connect/reply timeout, seconds")
+    args = ap.parse_args(argv)
+
+    host, _, port = args.gateway.rpartition(":")
+    if not host or not port.isdigit():
+        ap.error(f"--gateway must be host:port (got {args.gateway!r})")
+    addr = (host, int(port))
+
+    if args.json or args.once:
+        try:
+            status = fetch_status(addr, timeout=args.timeout)
+        except (ConnectionError, OSError) as e:
+            print(f"fleet_top: gateway {args.gateway} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(status, indent=2, sort_keys=True) if args.json
+              else render(status))
+        return 0
+
+    try:
+        while True:
+            try:
+                panel = render(fetch_status(addr, timeout=args.timeout))
+            except (ConnectionError, OSError) as e:
+                panel = (f"gateway {args.gateway} unreachable: {e}\n"
+                         f"  (retrying every {args.interval:g}s — a "
+                         f"restarting gateway comes back on its own)")
+            sys.stdout.write("\x1b[2J\x1b[H" + panel + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,9 +36,14 @@ def load_series(log_dir: str, tags):
     series = {t: [] for t in tags}
     t0 = min((r["wall"] for r in rows), default=None)
     for r in rows:
-        if r["tag"] in series:
-            series[r["tag"]].append((r["wall"], r.get("step", 0),
-                                     r["value"]))
+        # histogram rows carry p50/p95/max instead of a value — plot the
+        # p95 when a histogram tag is requested, skip span rows
+        if r.get("kind") == "span" or r["tag"] not in series:
+            continue
+        val = r["value"] if "value" in r else r.get("p95")
+        if val is None:
+            continue
+        series[r["tag"]].append((r["wall"], r.get("step", 0), val))
     return series, t0
 
 
