@@ -40,13 +40,27 @@ Three measurements, merged into ONE printed JSON line:
    design decision (agents/actor.py), re-taken on whatever hardware runs
    this bench so the decision is data, not folklore.
 
-5. **e2e** — the BASELINE.md north-star accounting: env frames/sec with
+5. **actor_pipeline** — the ISSUE-4 actor hot loop, serial vs
+   software-pipelined, on the production 16-env Nature-CNN shape:
+   per-phase tick breakdown, frames/s for both schedules, the env-only
+   ceiling, and ``overlap_efficiency`` (hidden device time / total
+   device time — how much of the serial ``act`` cost the pipeline
+   hides under host work).
+
+6. **e2e** — the BASELINE.md north-star accounting: env frames/sec with
    live actors + learner.  Runs the real config-8 topology (process
-   backend, native batched pong stepper, HBM replay, replay-ratio pacing)
-   for a short wall-clock window and reads ``actor/total_nframes`` /
+   backend, native batched pong stepper, HBM replay, replay-ratio
+   pacing, and the ISSUE-4 actor plane: pipelined actors, or the
+   SEED-style batched-inference backend when an accelerator hosts the
+   learner — ``e2e_actor_backend`` records which) for a short
+   wall-clock window and reads ``actor/total_nframes`` /
    ``learner/counter`` off the run's scalars — the same accounting as
    reference core/single_processes/dqn_logger.py:42.  Frames are agent
    steps (x4 emulated frames each, reference atari_env.py:95).
+   ``e2e_actor_tick_ms`` carries the actors' phase medians (sync =
+   blocked on the in-flight forward, dispatch = issue cost, param_swap
+   = weight-refresh stall) and ``e2e_overlap_efficiency`` the fraction
+   of per-tick device/server wait hidden under host work.
 
 The merged line carries ``bench_schema`` (round-3 advisor finding: the
 headline key's meaning changed once — K=256 peak -> K=32 production —
@@ -629,8 +643,100 @@ def bench_act_ab() -> dict:
     return {"act_ab": out} if out else {}
 
 
+def bench_actor_pipeline(envs: int = 16, ticks: int = 300) -> dict:
+    """Actor hot-loop section (ISSUE 4): serial vs software-pipelined
+    schedules on the production actor shape (pong-sim vector, Nature-CNN
+    forward on the host CPU — the inline/pipelined backends always run
+    inference host-side; the accelerator-served ``batched`` backend is
+    measured by the e2e section, where a learner process owns the chip).
+
+    Reported per schedule: per-tick phase breakdown (ms; the jit-compile
+    tick is excluded by dropping each phase's max before averaging) and
+    the implied frames/s.  Plus:
+
+    - ``env_only_frames_per_sec`` — the ceiling if inference were free:
+      the bare env vector stepped with constant actions;
+    - ``overlap_efficiency`` — hidden device time / total device time:
+      of the act time the serial schedule pays (``act`` = dispatch +
+      blocked sync), the fraction the pipelined schedule hides under
+      host work, ``(act_serial - sync - dispatch) / act_serial``.  On a
+      one-core host CPU compute cannot actually overlap host python — so
+      this number is ALSO the honest measure of how much of the "act"
+      cost was dispatch/transfer latency rather than compute.
+    """
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.factory import build_env_vector
+    from pytorch_distributed_tpu.agents.actor import bounded_actor_run
+
+    root = tempfile.mkdtemp(prefix="bench_actor_")
+
+    def adjusted(timer_ms, phase):
+        """Per-call ms with the single worst call (the compile) dropped."""
+        mean = timer_ms.get(f"actor/time_{phase}_ms")
+        if mean is None:
+            return None
+        mx = timer_ms[f"actor/time_{phase}_max_ms"]
+        n = timer_ms[f"actor/time_{phase}_calls"]
+        if n <= 1:
+            return round(mean, 3)
+        return round((mean * n - mx) / (n - 1), 3)
+
+    out = {"envs": envs, "ticks": ticks}
+    for backend in ("inline", "pipelined"):
+        opt = build_options(
+            4, root_dir=root, refs=f"actor_{backend}", num_actors=1,
+            num_envs_per_actor=envs, actor_backend=backend,
+            visualize=False,
+            # no mid-run flush/sync: the timer must hold the whole run
+            actor_freq=10 ** 9, actor_sync_freq=10 ** 9)
+        res = bounded_actor_run(opt, ticks)
+        t = res["timer_ms"]
+        phases = {p: adjusted(t, p)
+                  for p in ("act", "sync", "dispatch", "env", "advance")
+                  if adjusted(t, p) is not None}
+        host = (("sync", "dispatch", "env", "advance")
+                if backend == "pipelined" else ("act", "env", "advance"))
+        tick_ms = sum(phases[p] for p in host if p in phases)
+        out[backend] = {
+            "tick_ms": round(tick_ms, 3),
+            "frames_per_sec": round(envs / tick_ms * 1e3, 1) if tick_ms
+            else None,
+            "phases_ms": phases,
+        }
+        print(f"[bench_actor_pipeline] {backend}: {out[backend]}",
+              file=sys.stderr, flush=True)
+    # env-only ceiling: the same vector stepped with constant actions
+    opt = build_options(4, root_dir=root, refs="actor_env_only",
+                        num_envs_per_actor=envs, visualize=False)
+    env = build_env_vector(opt, 0, envs)
+    env.train()
+    env.reset()
+    acts = np.zeros(envs, dtype=np.int64)
+    for _ in range(10):
+        env.step(acts)
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        env.step(acts)
+    env_tick = (time.perf_counter() - t0) / ticks
+    out["env_only_frames_per_sec"] = round(envs / env_tick, 1)
+    act_serial = out["inline"]["phases_ms"].get("act")
+    pip = out["pipelined"]["phases_ms"]
+    if act_serial:
+        hidden = act_serial - pip.get("sync", 0.0) - pip.get("dispatch",
+                                                             0.0)
+        out["overlap_efficiency"] = round(
+            min(max(hidden / act_serial, 0.0), 1.0), 4)
+    if out["inline"].get("frames_per_sec") and \
+            out["pipelined"].get("frames_per_sec"):
+        out["pipeline_speedup"] = round(
+            out["pipelined"]["frames_per_sec"]
+            / out["inline"]["frames_per_sec"], 3)
+    return {"actor_pipeline": out}
+
+
 def bench_e2e(seconds: float = 60.0, actors: int = 1,
-              envs_per_actor: int = 16) -> dict:
+              envs_per_actor: int = 16,
+              actor_backend: str | None = None) -> dict:
     """North-star accounting: env frames/s + paced updates/s with the full
     config-8 topology live (actors -> feeder -> HBM replay -> learner).
 
@@ -642,9 +748,20 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
     reference-scale fan-out drive (reference main.py:68-80 spawns
     num_actors processes), converting the many-actor architecture claim
     into a measured aggregate rate on whatever host runs this."""
+    import jax
+
     from pytorch_distributed_tpu import runtime
     from pytorch_distributed_tpu.config import build_options
     from pytorch_distributed_tpu.utils.metrics import read_scalars
+
+    if actor_backend is None:
+        # with an accelerator present the learner parent owns it and can
+        # host the SEED-style inference batcher — actor ticks stop being
+        # host-CPU convnet forwards (ISSUE 4); CPU-only hosts keep the
+        # local pipelined loop
+        actor_backend = ("batched"
+                         if jax.devices()[0].platform != "cpu"
+                         else "pipelined")
 
     t_start = time.perf_counter()
 
@@ -657,6 +774,7 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
         8, root_dir=root, refs="bench_e2e", num_actors=actors,
         num_envs_per_actor=envs_per_actor, batch_size=128, visualize=False,
         learn_start=1000, max_replay_ratio=8.0, logger_freq=5,
+        actor_backend=actor_backend,
         evaluator_nepisodes=0,  # no evaluator process in the bench
         steps=10 ** 9, max_seconds=seconds + 45.0)
 
@@ -696,6 +814,7 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
         "e2e_seconds": round(t1 - t0, 1),
         "e2e_actors": f"{actors}x{envs_per_actor} envs",
         "e2e_num_actors": actors,
+        "e2e_actor_backend": actor_backend,
     }
     lr = [v for w, v in lrates if w >= cut]
     if lr:
@@ -706,24 +825,46 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
     # (advance).  Medians over the kept window, ms per vector tick.
     breakdown = {}
     for tag in ("actor/time_act_ms", "actor/time_env_ms",
-                "actor/time_advance_ms"):
+                "actor/time_advance_ms", "actor/time_sync_ms",
+                "actor/time_dispatch_ms", "actor/time_param_swap_ms"):
         vals = [r["value"] for r in rows
                 if r["tag"] == tag and r["wall"] >= cut]
         if vals:
             breakdown[tag.split("/")[-1]] = round(float(np.median(vals)), 3)
     if breakdown:
         out["e2e_actor_tick_ms"] = breakdown
+    # pipelined/batched actors: overlap efficiency = the host work the
+    # in-flight dispatch hid / the device-wait it couldn't hide + that
+    # hidden work — per-tick, from the actors' own phase timers.  1.0
+    # means every device/server microsecond was covered by env stepping
+    # and feed work; 0 means the pipeline never hid anything (the serial
+    # loop's behaviour by construction).
+    if "time_sync_ms" in breakdown:
+        hidden = breakdown.get("time_env_ms", 0.0) + breakdown.get(
+            "time_advance_ms", 0.0)
+        wait = breakdown["time_sync_ms"] + breakdown.get(
+            "time_dispatch_ms", 0.0)
+        if hidden + wait > 0:
+            out["e2e_overlap_efficiency"] = round(
+                hidden / (hidden + wait), 4)
     return out
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("micro", "e2e", "both", "families",
-                                       "sampler", "act"),
+                                       "sampler", "act", "actor"),
                     default="both")
     ap.add_argument("--e2e-seconds", type=float, default=60.0)
     ap.add_argument("--e2e-actors", type=int, default=1)
     ap.add_argument("--e2e-envs", type=int, default=16)
+    ap.add_argument("--e2e-actor-backend", type=str, default=None,
+                    choices=("inline", "pipelined", "batched"),
+                    help="override the e2e actor schedule (default: "
+                         "batched on accelerator hosts, else pipelined)")
+    ap.add_argument("--actor-envs", type=int, default=16,
+                    help="env-vector width for the actor-pipeline section")
+    ap.add_argument("--actor-ticks", type=int, default=300)
     args = ap.parse_args()
 
     import jax
@@ -743,9 +884,12 @@ def main() -> None:
         result.update(bench_sampler())
     if args.mode in ("both", "act"):
         result.update(bench_act_ab())
+    if args.mode in ("both", "actor"):
+        result.update(bench_actor_pipeline(args.actor_envs,
+                                           args.actor_ticks))
     if args.mode in ("e2e", "both"):
         result.update(bench_e2e(args.e2e_seconds, args.e2e_actors,
-                                args.e2e_envs))
+                                args.e2e_envs, args.e2e_actor_backend))
 
     headline = result.get("updates_per_sec")
     n_dev = len(jax.devices())
@@ -772,11 +916,17 @@ def main() -> None:
     else:  # sampler/act-only invocations have no throughput headline
         metric, value, unit = f"bench_{args.mode}", None, "see section keys"
     out = {
-        # schema 2: production-K headline (since r3), fused families rows
-        # with steps_per_dispatch, sampler + act-A/B sections (r4).  Bump
-        # whenever a key's MEANING changes so longitudinal consumers
-        # never compare across semantics (round-3 advisor finding).
-        "bench_schema": 2,
+        # schema 3: the e2e section now runs the ISSUE-4 actor plane —
+        # software-pipelined actors by default, the SEED-style batched
+        # inference backend on accelerator hosts (e2e_actor_backend says
+        # which) — so e2e_frames_per_sec is not comparable to schema-2
+        # rows measured with serial host-CPU actors; adds the
+        # actor_pipeline section and e2e_overlap_efficiency.  Schema 2
+        # (r3): production-K headline, fused families rows, sampler +
+        # act-A/B sections.  Bump whenever a key's MEANING changes so
+        # longitudinal consumers never compare across semantics
+        # (round-3 advisor finding).
+        "bench_schema": 3,
         "metric": metric,
         "value": value,
         "unit": unit,

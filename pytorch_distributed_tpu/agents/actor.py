@@ -3,23 +3,45 @@
 Re-design of reference core/single_processes/dqn_actor.py and
 ddpg_actor.py.  Same topology — rollout workers with a full local model
 replica, diversified by the Ape-X exploration schedule and per-process
-seeds — with two structural upgrades:
+seeds — with three structural upgrades:
 
 - the reference's implicit shared-CUDA weight pulls become versioned
   ``ParamStore`` fetches on the ``actor_sync_freq`` cadence (reference
-  dqn_actor.py:176-178), and its inline deque bookkeeping becomes the
-  unit-tested ``NStepAssembler``;
+  dqn_actor.py:176-178) — prefetched off the hot path by a
+  ``ParamPrefetcher`` thread so a version swap never stalls a tick — and
+  its inline deque bookkeeping becomes the unit-tested ``NStepAssembler``;
 - every actor is **vectorized**: it steps ``num_envs_per_actor`` envs with
   ONE jitted batched forward per tick (envs/vector.py) — the reference
   reserves this knob but asserts it to 1 (reference utils/options.py:32);
-  batch-1 inference is the latency wall SURVEY.md §7 flags, and batching is
-  how a TPU-host actor feeds the learner fast enough.  N=1 degenerates to
-  the reference's exact per-step loop.
+- the hot loop is **software-pipelined** (ISSUE 4 tentpole): the jitted
+  ``act`` for tick k+1 is dispatched asynchronously (JAX async dispatch)
+  right after tick k's env step, so the device forward overlaps the
+  host's feed/advance work, and the action sync happens at the last
+  moment as ONE packed device→host copy.  The per-tick host work the
+  serial loop carried — key splits, three separate device reads — is
+  fused into the jitted step (models/policies.build_packed_act: the PRNG
+  key stays on-device, a tick counter is folded in instead of a
+  host-side split chain).
+
+Three interchangeable backends (``env_params.actor_backend``), all
+bit-identical action/transition streams under a fixed seed because
+per-tick randomness is a pure function of (actor, tick, env row):
+
+- ``inline``   — the serial schedule: dispatch, sync, step, feed.  The
+  fallback and the determinism reference.
+- ``pipelined`` — the two-stage overlapped schedule above (default).
+- ``batched``  — SEED-style: no local model at all; obs go to the shared
+  ``InferenceServer`` in the accelerator-owning process
+  (agents/inference.py) and the wide forward runs there.  Requires the
+  co-located server; downgrades to ``pipelined`` with a warning when
+  none is wired in (e.g. remote DCN actor hosts).
 
 Cadences mirror the reference: stats pushed every ``actor_freq`` env steps
 (reference dqn_actor.py:180-192), global actor-step counter advanced per
 env step (reference :166-167), loop until the global learner clock reaches
-``steps`` (reference :62).
+``steps`` (reference :62).  The weight-sync cadence is checked at ONE
+defined point per tick (after the env step, before the next dispatch) so
+the inline and pipelined schedules see identical staleness.
 
 Exploration diversity follows Ape-X across the whole fleet: env ``j`` of
 actor ``i`` takes exploration slot ``i*N + j`` of ``num_actors*N``
@@ -28,17 +50,19 @@ actor ``i`` takes exploration slot ``i*N + j`` of ``num_actors*N``
 
 from __future__ import annotations
 
-from typing import Any, List
+import time
+from typing import Any, List, Optional
 
 import numpy as np
 
 from pytorch_distributed_tpu.config import Options
 from pytorch_distributed_tpu.factory import (
     EnvSpec, build_env_vector, build_model, init_params,
+    resolve_actor_backend,
 )
 from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
 from pytorch_distributed_tpu.agents.param_store import (
-    ParamStore, make_flattener,
+    ParamPrefetcher, ParamStore, make_flattener,
 )
 from pytorch_distributed_tpu.ops.nstep import NStepAssembler
 from pytorch_distributed_tpu.utils.random_process import (
@@ -56,7 +80,7 @@ class _ActorHarness:
 
     def __init__(self, opt: Options, spec: EnvSpec, process_ind: int,
                  memory: Any, param_store: ParamStore, clock: GlobalClock,
-                 stats: ActorStats):
+                 stats: ActorStats, backend: str = "pipelined"):
         self.opt = opt
         self.ap = opt.agent_params
         self.spec = spec
@@ -65,31 +89,52 @@ class _ActorHarness:
         self.param_store = param_store
         self.clock = clock
         self.stats = stats
+        self.backend = backend
 
         self.num_envs = max(1, opt.env_params.num_envs_per_actor)
         self.env = build_env_vector(opt, process_ind, self.num_envs)
         self.env.train()
-        self.model = build_model(opt, spec)
-        params0 = init_params(opt, spec, self.model, seed=process_seed(
-            opt.seed, "actor", process_ind))
-        _, self.unravel = make_flattener(params0)
-        # block until the learner publishes the initial weights — the
-        # explicit version of the reference's pre-spawn hard sync
-        # (reference dqn_actor.py:26-30).  Generous timeout: the first
-        # publication sits behind the learner process's remote XLA
-        # compiles, which can take minutes on a tunnelled chip; a dead
-        # learner is caught by the stop event, not this timeout.
-        flat, self.version = param_store.wait(0, timeout=300.0,
-                                              stop=clock.stop)
+        self._prefetch: Optional[ParamPrefetcher] = None
+        if backend == "batched":
+            # SEED-style actor: inference lives with the accelerator, so
+            # this process holds NO model replica — no init, no
+            # flattener, no per-cadence fetch/unravel (the serial loop's
+            # single biggest off-tick cost).  The initial wait stays: it
+            # is the learner-alive barrier every worker starts behind.
+            self.model = None
+            self.unravel = None
+            self.params = None
+            _flat, self.version = param_store.wait(0, timeout=300.0,
+                                                   stop=clock.stop)
+        else:
+            self.model = build_model(opt, spec)
+            params0 = init_params(opt, spec, self.model, seed=process_seed(
+                opt.seed, "actor", process_ind))
+            _, self.unravel = make_flattener(params0)
+            # block until the learner publishes the initial weights — the
+            # explicit version of the reference's pre-spawn hard sync
+            # (reference dqn_actor.py:26-30).  Generous timeout: the first
+            # publication sits behind the learner process's remote XLA
+            # compiles, which can take minutes on a tunnelled chip; a dead
+            # learner is caught by the stop event, not this timeout.
+            flat, self.version = param_store.wait(0, timeout=300.0,
+                                                  stop=clock.stop)
+            # rollout inference is pinned to the host CPU: the learner owns
+            # the accelerator; batch-1/small-batch forwards must not
+            # round-trip a (possibly tunnelled) chip (helpers.pin_to_cpu)
+            self.params = unravel_on_cpu(self.unravel, flat)
+            # weight refresh happens off the hot path from here on: the
+            # prefetcher thread does the fetch+unravel, the tick-side
+            # swap is a reference exchange (ParamPrefetcher docstring)
+            self._prefetch = ParamPrefetcher(
+                param_store,
+                lambda f: unravel_on_cpu(self.unravel, f),
+                start_version=self.version)
         if hasattr(memory, "set_stop"):
             # stop-aware feeding: a flush blocked on a full queue after
             # the learner stopped draining must abort, not deadlock the
             # teardown join
             memory.set_stop(clock.stop)
-        # rollout inference is pinned to the host CPU: the learner owns
-        # the accelerator; batch-1/small-batch forwards must not round-trip
-        # a (possibly tunnelled) chip (utils/helpers.py pin_to_cpu)
-        self.params = unravel_on_cpu(self.unravel, flat)
 
         N = self.num_envs
         self.assemblers: List[NStepAssembler] = [
@@ -134,12 +179,36 @@ class _ActorHarness:
 
     # -- one vector tick ----------------------------------------------------
 
+    def tick_sync(self) -> None:
+        """Once per vector tick, at the ONE schedule-invariant point
+        (after the env step, before the next act dispatch): bump the
+        global/local step counters and run the weight-sync cadence.  The
+        swap itself is non-blocking — the prefetcher already did the
+        fetch+unravel on its own thread — and is timed as ``param_swap``
+        so any residual stall is visible in traces (ISSUE 4
+        satellite)."""
+        N = self.num_envs
+        self.env_steps += N
+        self.clock.add_actor_steps(N)  # reference dqn_actor.py:166-167
+        self._acc["total_nframes"] += N
+        if self.env_steps >= self._next_sync:
+            self._next_sync += self.ap.actor_sync_freq
+            if self._prefetch is not None:
+                t0 = time.perf_counter()
+                got = self._prefetch.take()
+                if got is not None:
+                    self.params, self.version = got
+                    self.timer.add("param_swap",
+                                   time.perf_counter() - t0)
+
     def advance(self, actions, next_obs, rewards, terminals, infos,
                 q_sel=None, q_max=None) -> None:
-        """Feed assemblers/memory for one batched env step and run every
-        cadence (counter, stats, weight sync).  ``q_sel``/``q_max`` are this
-        tick's per-env Q diagnostics from the batched forward (DQN actors);
-        with PER enabled they become initial priorities."""
+        """Feed assemblers/memory for one batched env step and run the
+        stat-flush cadence.  ``q_sel``/``q_max`` are this tick's per-env Q
+        diagnostics from the batched forward (DQN actors); with PER
+        enabled they become initial priorities.  In the pipelined
+        schedule this host work runs while the NEXT tick's forward is
+        already in flight on the device."""
         if self.per_priorities:
             self._resolve_pending(q_max)
         for j in range(self.num_envs):
@@ -162,7 +231,7 @@ class _ActorHarness:
                 self._record_episode(j, infos[j])
                 self.on_env_reset(j)
         self._obs = next_obs
-        self._run_cadences()
+        self._flush_cadence()
 
     def _record_episode(self, j: int, info: dict) -> None:
         """Fold env slot j's finished episode into the stat accumulators."""
@@ -174,13 +243,9 @@ class _ActorHarness:
         self.episode_steps[j] = 0
         self.episode_reward[j] = 0.0
 
-    def _run_cadences(self) -> None:
-        """Per-tick counter bump + the stat-flush and weight-sync cadences
-        (reference dqn_actor.py:166-192)."""
-        N = self.num_envs
-        self.env_steps += N
-        self.clock.add_actor_steps(N)  # reference dqn_actor.py:166-167
-        self._acc["total_nframes"] += N
+    def _flush_cadence(self) -> None:
+        """Stat-flush cadence (reference dqn_actor.py:180-192); the
+        weight-sync cadence lives in ``tick_sync``."""
         if self.env_steps >= self._next_flush:
             self._next_flush += self.ap.actor_freq
             self.flush_stats()
@@ -189,12 +254,6 @@ class _ActorHarness:
             self.tracer.flush_to(self._timing_writer, step=step)
             if hasattr(self.memory, "flush"):
                 self.memory.flush()  # queue feeders drain on the cadence
-        if self.env_steps >= self._next_sync:
-            self._next_sync += self.ap.actor_sync_freq
-            got = self.param_store.fetch(self.version)
-            if got is not None:
-                flat, self.version = got
-                self.params = unravel_on_cpu(self.unravel, flat)
 
     # -- actor-side TD-error priorities (PER) -------------------------------
 
@@ -243,6 +302,8 @@ class _ActorHarness:
             self._acc = dict.fromkeys(ActorStats.FIELDS, 0.0)
 
     def shutdown(self) -> None:
+        if self._prefetch is not None:
+            self._prefetch.close()
         # Best-effort final drain: over DCN a terminally disconnected
         # transport raises from these feeds/flushes (parallel/dcn.py
         # DcnDisconnected), and a teardown crash here would mask WHY the
@@ -269,46 +330,230 @@ class _ActorHarness:
         self._timing_writer.close()
 
 
+# ---------------------------------------------------------------------------
+# Act engines: submit/collect pairs the loop driver schedules.
+#
+# ``submit(obs, tick, reset_mask)`` dispatches the tick's forward and
+# returns an opaque handle WITHOUT blocking on the result (JAX async
+# dispatch locally; a queue send to the shared server in batched mode).
+# ``collect(handle)`` syncs the result into numpy at the last moment and
+# returns ``(actions, advance_kwargs)``.  One engine instance is scheduled
+# by both the inline and the pipelined loops, so the two backends can
+# never drift numerically.
+# ---------------------------------------------------------------------------
+
+
+def _unpack_dqn(packed: np.ndarray):
+    """(3, B) packed (action, q_sel, q_max) -> advance arguments."""
+    return (packed[0].astype(np.int64),
+            dict(q_sel=packed[1], q_max=packed[2]))
+
+
+class _LocalDqnEngine:
+    """Fused eps-greedy forward on this process's host CPU."""
+
+    def __init__(self, h: _ActorHarness, base_key, eps):
+        import jax.numpy as jnp
+
+        from pytorch_distributed_tpu.models.policies import build_packed_act
+
+        self._h = h
+        self._act = build_packed_act(h.model.apply)
+        self._key = pin_to_cpu(base_key)
+        self._eps = pin_to_cpu(jnp.asarray(eps, jnp.float32))
+
+    def submit(self, obs, tick, reset_mask):
+        out = self._act(self._h.params, obs, self._key, tick, self._eps)
+        out.copy_to_host_async()  # D2H overlaps the host work too
+        return out
+
+    def collect(self, pending):
+        return _unpack_dqn(np.asarray(pending))
+
+    def jit_cache_size(self) -> Optional[int]:
+        return self._act._cache_size()
+
+    def close(self) -> None:
+        pass
+
+
+def _ou_explore(h: _ActorHarness, a: np.ndarray) -> np.ndarray:
+    """Add the harness's OU exploration noise to a deterministic policy
+    output and clip to the action box — ONE implementation shared by the
+    local and batched DDPG engines, because both schedules' noise
+    streams must stay bit-identical (the tests' oracle) and a divergence
+    here would desync them silently."""
+    noise = h.ou.sample().reshape(h.num_envs, h.spec.action_dim)
+    return np.clip(a + noise, -1.0, 1.0).astype(np.float32)
+
+
+class _LocalDdpgEngine:
+    """Deterministic policy forward; OU noise stays host-side at sync
+    time so the noise stream is schedule-invariant."""
+
+    def __init__(self, h: _ActorHarness):
+        from pytorch_distributed_tpu.models.policies import build_ddpg_act
+
+        self._h = h
+        self._act = build_ddpg_act(lambda p, o: h.model.apply(
+            p, o, method=h.model.forward_actor))
+
+    def submit(self, obs, tick, reset_mask):
+        out = self._act(self._h.params, obs)
+        out.copy_to_host_async()
+        return out
+
+    def collect(self, pending):
+        return _ou_explore(self._h, np.asarray(pending)), {}
+
+    def jit_cache_size(self) -> Optional[int]:
+        return self._act._cache_size()
+
+    def close(self) -> None:
+        pass
+
+
+class _BatchedDqnEngine:
+    """Submit obs to the shared InferenceServer (agents/inference.py)."""
+
+    def __init__(self, client, base_key, eps):
+        self._client = client
+        client.begin_session(base_key=np.asarray(base_key),
+                             eps=np.asarray(eps, np.float32))
+
+    def submit(self, obs, tick, reset_mask):
+        return self._client.submit(obs, tick)
+
+    def collect(self, pending):
+        return _unpack_dqn(self._client.collect(pending))
+
+    def jit_cache_size(self) -> Optional[int]:
+        return None  # the jit lives server-side
+
+    def close(self) -> None:
+        pass
+
+
+class _BatchedDdpgEngine:
+    def __init__(self, h: _ActorHarness, client):
+        self._h = h
+        self._client = client
+        client.begin_session()
+
+    def submit(self, obs, tick, reset_mask):
+        return self._client.submit(obs, tick)
+
+    def collect(self, pending):
+        return _ou_explore(self._h, self._client.collect(pending)), {}
+
+    def jit_cache_size(self) -> Optional[int]:
+        return None
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# The loop driver: one schedule for every family and backend.
+# ---------------------------------------------------------------------------
+
+
+def _drive_actor_loop(h: _ActorHarness, engine, clock: GlobalClock,
+                      pipelined: bool) -> _ActorHarness:
+    """Run the actor loop to the global clock's termination.
+
+    Serial (``pipelined=False``)::
+
+        act(k) . sync . env(k) . tick_sync . feed(k)
+
+    Pipelined (``pipelined=True``) — the ISSUE 4 two-stage software
+    pipeline; act(k+1) is IN FLIGHT on the device while the host feeds
+    tick k::
+
+        sync(k) . env(k) . tick_sync . dispatch act(k+1) . feed(k)
+
+    Both schedules drive the same engine in the same per-tick order
+    (submit once, collect once, tick_sync between env step and next
+    dispatch), so their action/transition streams are bit-identical
+    under a fixed seed.  Timer phases: the serial loop books ``act``;
+    the pipelined loop books ``dispatch`` (issue cost), ``sync``
+    (blocked-on-device time — the part overlap is hiding) and an ``act``
+    aggregate of the two so dashboards compare across schedules.
+    """
+    timer = h.timer
+    h.engine = engine  # introspection: bench/tests read jit_cache_size
+    h.start()
+    tick = 0
+    reset_mask = np.zeros(h.num_envs, dtype=bool)
+    pending = None
+    if pipelined:
+        t0 = time.perf_counter()
+        pending = engine.submit(h._obs, 0, reset_mask)
+        timer.add("dispatch", time.perf_counter() - t0)
+    t_sync = 0.0
+    while not clock.done(h.ap.steps):
+        if pipelined:
+            t0 = time.perf_counter()
+            actions, extras = engine.collect(pending)
+            t_sync = time.perf_counter() - t0
+            timer.add("sync", t_sync)
+        else:
+            t0 = time.perf_counter()
+            pending = engine.submit(h._obs, tick, reset_mask)
+            actions, extras = engine.collect(pending)
+            timer.add("act", time.perf_counter() - t0)
+        with timer.phase("env"):
+            next_obs, rewards, terminals, infos = h.env.step(actions)
+        h.tick_sync()
+        tick += 1
+        if pipelined:
+            t0 = time.perf_counter()
+            pending = engine.submit(next_obs, tick, terminals)
+            t_disp = time.perf_counter() - t0
+            timer.add("dispatch", t_disp)
+            timer.add("act", t_sync + t_disp)
+        else:
+            reset_mask = terminals
+        with timer.phase("advance"):
+            h.advance(actions, next_obs, rewards, terminals, infos,
+                      **extras)
+    h.shutdown()
+    engine.close()
+    return h
+
+
 def run_dqn_actor(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                   param_store: ParamStore, clock: GlobalClock,
-                  stats: ActorStats) -> None:
+                  stats: ActorStats, inference: Any = None):
     """eps-greedy rollout worker (reference dqn_actor.py:9-192), batched
-    over the actor's env vector."""
-    import jax
+    over the actor's env vector and scheduled per ``actor_backend``."""
+    from pytorch_distributed_tpu.models.policies import apex_epsilons
 
-    from pytorch_distributed_tpu.models.policies import (
-        apex_epsilons, build_epsilon_greedy_act,
-    )
-
+    backend = resolve_actor_backend(opt, inference)
     h = _ActorHarness(opt, spec, process_ind, memory, param_store, clock,
-                      stats)
-    act = build_epsilon_greedy_act(h.model.apply)
+                      stats, backend=backend)
     eps = apex_epsilons(process_ind, opt.num_actors, h.num_envs,
                         h.ap.eps, h.ap.eps_alpha)
-    key = pin_to_cpu(process_key(opt.seed, "actor", process_ind))
-
-    h.start()
-    while not clock.done(h.ap.steps):
-        with h.timer.phase("act"):
-            key, sub = jax.random.split(key)
-            a, q_sel, q_max = act(h.params, h._obs, sub, eps)
-            actions = np.asarray(a)
-        with h.timer.phase("env"):
-            next_obs, rewards, terminals, infos = h.env.step(actions)
-        with h.timer.phase("advance"):
-            h.advance(actions, next_obs, rewards, terminals, infos,
-                      q_sel=np.asarray(q_sel), q_max=np.asarray(q_max))
-    h.shutdown()
+    base_key = process_key(opt.seed, "actor", process_ind)
+    if backend == "batched":
+        engine = _BatchedDqnEngine(inference, base_key, eps)
+    else:
+        engine = _LocalDqnEngine(h, base_key, eps)
+    return _drive_actor_loop(h, engine, clock,
+                             pipelined=(backend != "inline"))
 
 
 def run_ddpg_actor(opt: Options, spec: EnvSpec, process_ind: int,
                    memory: Any, param_store: ParamStore, clock: GlobalClock,
-                   stats: ActorStats) -> None:
+                   stats: ActorStats, inference: Any = None):
     """OU-noise rollout worker (reference ddpg_actor.py:9-172): same
     skeleton with one OrnsteinUhlenbeckProcess state per env (theta/sigma
     from AgentParams, anneal over memory_size*100 steps — reference
-    ddpg_actor.py:34-35)."""
-    from pytorch_distributed_tpu.models.policies import build_ddpg_act
+    ddpg_actor.py:34-35).  Rides the shared loop driver, so — unlike the
+    original loop, which skipped them (ISSUE 4 satellite) — its
+    act/env/advance tick breakdown reaches the metrics stream exactly
+    like the DQN family's."""
+    backend = resolve_actor_backend(opt, inference)
 
     class _DdpgHarness(_ActorHarness):
         ou: OrnsteinUhlenbeckProcess  # set right after construction
@@ -318,10 +563,8 @@ def run_ddpg_actor(opt: Options, spec: EnvSpec, process_ind: int,
             self.ou.x_prev.reshape(self.num_envs, -1)[j] = self.ou.x0
 
     h = _DdpgHarness(opt, spec, process_ind, memory, param_store, clock,
-                     stats)
-    act = build_ddpg_act(lambda p, o: h.model.apply(
-        p, o, method=h.model.forward_actor))
-    h.ou = ou = OrnsteinUhlenbeckProcess(
+                     stats, backend=backend)
+    h.ou = OrnsteinUhlenbeckProcess(
         size=h.num_envs * spec.action_dim,
         theta=h.ap.ou_theta,
         mu=h.ap.ou_mu,
@@ -329,12 +572,80 @@ def run_ddpg_actor(opt: Options, spec: EnvSpec, process_ind: int,
         n_steps_annealing=opt.memory_params.memory_size * 100,
         seed=process_seed(opt.seed, "actor", process_ind) + 17,
     )
+    if backend == "batched":
+        engine = _BatchedDdpgEngine(h, inference)
+    else:
+        engine = _LocalDdpgEngine(h)
+    return _drive_actor_loop(h, engine, clock,
+                             pipelined=(backend != "inline"))
 
-    h.start()
-    while not clock.done(h.ap.steps):
-        a = np.asarray(act(h.params, h._obs))
-        noise = ou.sample().reshape(h.num_envs, spec.action_dim)
-        actions = np.clip(a + noise, -1.0, 1.0).astype(np.float32)
-        next_obs, rewards, terminals, infos = h.env.step(actions)
-        h.advance(actions, next_obs, rewards, terminals, infos)
-    h.shutdown()
+
+# ---------------------------------------------------------------------------
+# In-process bounded runs (tests + bench.py actor-pipeline section)
+# ---------------------------------------------------------------------------
+
+
+class _RecordingSink:
+    """Memory stand-in that records every fed item in arrival order."""
+
+    def __init__(self):
+        self.items: List[tuple] = []
+
+    def feed(self, item, priority=None) -> None:
+        self.items.append((item, priority))
+
+
+def bounded_actor_run(opt: Options, ticks: int, spec: EnvSpec = None,
+                      process_ind: int = 0, inference: Any = None,
+                      param_seed: int = 0) -> dict:
+    """Run ONE actor loop in this process for exactly ``ticks`` vector
+    ticks against a recording sink and a single fixed parameter snapshot.
+
+    The harness behind the determinism tests (pipelined/batched streams
+    must be bit-identical to inline, tests/test_actor_pipeline.py) and
+    the bench's actor-pipeline section: no learner, no spawn — the param
+    store is pre-published once from ``init_params(seed=param_seed)``, so
+    two runs over the same opt see identical weights.  Returns
+    ``{"stream": [(item, priority), ...], "timer_ms": {...},
+    "harness": h}`` — the timer dict is the StepTimer drain (per-phase
+    mean/max/calls in ms) accumulated over the run, provided
+    ``actor_freq`` was set larger than ``ticks * num_envs`` (a mid-run
+    flush would drain it early).
+    """
+    import threading
+    import types
+
+    from pytorch_distributed_tpu.factory import get_worker, probe_env
+
+    spec = spec if spec is not None else probe_env(opt)
+    model = build_model(opt, spec)
+    flat0, _ = make_flattener(init_params(opt, spec, model,
+                                          seed=param_seed))
+    store = ParamStore(flat0.size)
+    store.publish(flat0)
+
+    class _BoundedClock:
+        """Quacks like GlobalClock; ends the loop after ``ticks``
+        iterations instead of at a learner-step horizon."""
+
+        def __init__(self, ticks_left: int):
+            self._left = ticks_left
+            self.stop = threading.Event()
+            self.learner_step = types.SimpleNamespace(value=0)
+
+        def done(self, steps: int) -> bool:
+            if self._left <= 0:
+                return True
+            self._left -= 1
+            return False
+
+        def add_actor_steps(self, n: int = 1) -> int:
+            return n
+
+    sink = _RecordingSink()
+    clock = _BoundedClock(ticks)
+    h = get_worker("actor", opt.agent_type)(
+        opt, spec, process_ind, sink, store, clock, ActorStats(),
+        inference)
+    return {"stream": sink.items, "timer_ms": h.timer.drain(),
+            "harness": h}

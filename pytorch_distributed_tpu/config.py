@@ -87,6 +87,20 @@ class EnvParams:
     # (utils/options.py:32, atari_env.py:15); here >1 is supported by the
     # sim envs and batched inference.
     num_envs_per_actor: int = 1
+    # Actor hot-loop schedule/placement (ISSUE 4):
+    #   "pipelined" — two-stage software pipeline (default): the jitted
+    #                 act for tick k+1 is dispatched asynchronously while
+    #                 the host feeds tick k; bit-identical streams to
+    #                 "inline" under a fixed seed.
+    #   "inline"    — the serial dispatch-sync-step-feed loop; the
+    #                 fallback and the determinism reference.
+    #   "batched"   — SEED-style shared inference: actors hold no model
+    #                 and submit obs to the InferenceServer thread in the
+    #                 accelerator-owning process (agents/inference.py).
+    #                 dqn/ddpg with a co-located server only; downgrades
+    #                 to "pipelined" otherwise (factory.
+    #                 resolve_actor_backend).
+    actor_backend: str = "pipelined"
     render: bool = False
     # Step sim envs through the first-party C++ batched stepper
     # (native/pong_batch.cpp) when the toolchain builds it; the Python

@@ -98,6 +98,57 @@ def get_worker(role: str, agent_type: str) -> Callable:
 
 
 # ---------------------------------------------------------------------------
+# Actor backend routing (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+ACTOR_BACKENDS = ("inline", "pipelined", "batched")
+
+
+def resolve_actor_backend(opt: Options, inference=None) -> str:
+    """The actor hot-loop schedule actually run, from the
+    ``env_params.actor_backend`` knob plus eligibility.
+
+    Decided HERE — one gate shared by the runners (agents/actor.py,
+    agents/recurrent_actor.py), the topology (runtime.py decides whether
+    to build an InferenceServer from the same predicate via
+    ``needs_inference_server``) and the fleet CLI — so the pieces can
+    never disagree.  ``batched`` needs a co-located server handle
+    (``inference``) and a flat family; anything else downgrades to
+    ``pipelined`` with a loud warning rather than failing a whole fleet
+    over a placement detail (remote DCN actor hosts have no server to
+    reach)."""
+    backend = getattr(opt.env_params, "actor_backend", "pipelined") \
+        or "pipelined"
+    if backend not in ACTOR_BACKENDS:
+        raise ValueError(
+            f"unknown actor_backend: {backend!r} (one of "
+            f"{ACTOR_BACKENDS})")
+    if backend == "batched":
+        import warnings
+
+        if opt.agent_type not in ("dqn", "ddpg"):
+            warnings.warn(
+                f"actor_backend=batched does not serve agent_type="
+                f"{opt.agent_type} (per-env recurrent state stays "
+                f"actor-side); falling back to pipelined", stacklevel=2)
+            return "pipelined"
+        if inference is None:
+            warnings.warn(
+                "actor_backend=batched but no InferenceClient was wired "
+                "in (remote actor host, or a topology without the "
+                "server); falling back to pipelined", stacklevel=2)
+            return "pipelined"
+    return backend
+
+
+def needs_inference_server(opt: Options) -> bool:
+    """Whether a topology should stand up the shared InferenceServer for
+    its co-located actors (runtime.Topology)."""
+    return (getattr(opt.env_params, "actor_backend", "") == "batched"
+            and opt.agent_type in ("dqn", "ddpg"))
+
+
+# ---------------------------------------------------------------------------
 # Env probe + builders
 # ---------------------------------------------------------------------------
 

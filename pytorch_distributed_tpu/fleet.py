@@ -451,6 +451,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--actor-count", type=int, default=8,
                     help="[actors] actors to run on this host")
     ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--actor-backend", type=str, default=None,
+                    choices=("inline", "pipelined", "batched"),
+                    help="actor hot-loop schedule (config.py EnvParams."
+                         "actor_backend): pipelined = overlapped "
+                         "two-stage loop (default), inline = serial "
+                         "fallback, batched = SEED-style shared "
+                         "inference on the learner host — applies to "
+                         "that host's LOCAL actors; remote actor hosts "
+                         "have no co-located server and auto-downgrade "
+                         "to pipelined (factory.resolve_actor_backend)")
     ap.add_argument("--resume", type=str, default=None, metavar="REFS",
                     help="[learner] resume run REFS from its newest "
                          "complete checkpoint epoch (models/REFS_ckpt — "
@@ -495,6 +505,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         overrides["num_actors"] = args.num_actors
     if args.seed is not None:
         overrides["seed"] = args.seed
+    if args.actor_backend is not None:
+        overrides["actor_backend"] = args.actor_backend
     if args.resume is not None:
         if args.role != "learner":
             ap.error("--resume applies to the learner host (actor hosts "

@@ -3,9 +3,11 @@ from pytorch_distributed_tpu.models.dqn_mlp import DqnMlpModel
 from pytorch_distributed_tpu.models.ddpg_mlp import DdpgMlpModel
 from pytorch_distributed_tpu.models.policies import (
     build_epsilon_greedy_act, build_ddpg_act, apex_epsilon,
+    build_packed_act, build_recurrent_packed_act,
 )
 
 __all__ = [
     "DqnCnnModel", "DqnMlpModel", "DdpgMlpModel",
     "build_epsilon_greedy_act", "build_ddpg_act", "apex_epsilon",
+    "build_packed_act", "build_recurrent_packed_act",
 ]

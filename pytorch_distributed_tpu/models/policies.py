@@ -69,6 +69,115 @@ def build_epsilon_greedy_act(apply_fn: Callable) -> Callable:
     return jax.jit(act)
 
 
+def tick_keys(base_key, tick, num_envs: int):
+    """Per-(tick, env-row) PRNG keys derived ON DEVICE: fold the tick
+    counter into the actor's base key, then fold each row index.  This is
+    the pipelined actor's replacement for the serial loop's host-side
+    ``jax.random.split`` chain (ISSUE 4 tentpole): the base key is
+    committed once and never leaves the device, per-tick randomness is a
+    pure function of ``(base_key, tick, row)``, and — because rows are
+    keyed independently — the SAME stream falls out whether rows are
+    evaluated by the local inline loop, the local pipelined loop, or a
+    shared inference server batching rows from many actors."""
+    k = jax.random.fold_in(base_key, tick)
+    return jax.vmap(lambda j: jax.random.fold_in(k, j))(
+        jnp.arange(num_envs))
+
+
+def _rowwise_eps_greedy(q, row_keys, eps):
+    """Row-keyed eps-greedy: each row draws from its own key so action
+    randomness is independent of how rows were batched together."""
+    num_actions = q.shape[-1]
+
+    def row(qr, key, e):
+        key_explore, key_choice = jax.random.split(key)
+        random_a = jax.random.randint(key_choice, (), 0, num_actions)
+        explore = jax.random.uniform(key_explore) < e
+        return jnp.where(explore, random_a, jnp.argmax(qr))
+
+    return jax.vmap(row)(q, row_keys, eps)
+
+
+def _pack_dqn(q, action):
+    """One (3, B) float32 array — (action, q_sel, q_max) rows — so a tick
+    costs ONE device->host copy instead of three (action indices are
+    small integers, exactly representable in f32)."""
+    q_sel = jnp.take_along_axis(q, action[:, None], axis=-1)[:, 0]
+    return jnp.stack([action.astype(jnp.float32),
+                      q_sel.astype(jnp.float32),
+                      jnp.max(q, axis=-1).astype(jnp.float32)])
+
+
+def build_packed_act(apply_fn: Callable) -> Callable:
+    """The pipelined actor's fused per-tick program (ISSUE 4 tentpole).
+
+    Returns a jitted ``act(params, obs[B,...], base_key, tick, eps[B]) ->
+    packed[3, B]`` where ``packed`` stacks (action, q_sel, q_max) as one
+    float32 array.  Everything the serial loop did on the host per tick —
+    key split, action selection, the three separate device reads — is
+    fused on-device: the PRNG key stays resident (``tick_keys`` folds the
+    tick counter instead of a host-side split chain), and the single
+    packed output means one dispatch + one D2H copy per tick.  ``tick``
+    is a traced scalar, so consecutive ticks NEVER retrace.
+
+    The obs is deliberately NOT donated: none of the shipped feedforward
+    nets produce an output that could alias it (XLA would just warn the
+    donation off).  The buffer donations that pay in this codebase are
+    the recurrent carry (``build_recurrent_packed_act``) and the
+    server-side roll stack (``build_packed_roll_act``).
+    """
+
+    def act(params, obs, base_key, tick, eps):
+        q = apply_fn(params, obs)                        # (B, A)
+        action = _rowwise_eps_greedy(q, tick_keys(base_key, tick,
+                                                  q.shape[0]), eps)
+        return _pack_dqn(q, action)
+
+    return jax.jit(act)
+
+
+def build_packed_roll_act(apply_fn: Callable) -> Callable:
+    """Frame-packed variant of ``build_packed_act`` for the shared
+    inference server (agents/inference.py): the client ships only the
+    NEWEST frame per env and the device rolls its resident history stack
+    before acting, fused into the same dispatch —
+    ``act(params, stack[B,C,H,W], new[B,H,W], base_key, tick, eps) ->
+    (stack', packed[3,B])``.
+
+    Over a tunnelled chip this cuts the per-tick upload by the stack
+    factor C (451 KB -> 113 KB for the production 16-env Nature-CNN
+    shape) — the difference between the obs plane fitting next to the
+    replay-ingest stream or fighting it for the link.  The stack is
+    DONATED (stack' has its exact shape/dtype, so XLA rolls in place).
+    The client only elects this path when the roll property held on the
+    host (``obs[:, :-1] == prev[:, 1:]`` — any env reset falls back to a
+    full upload that also reseeds the device stack), so the device
+    reconstruction is bit-exact with what the env emitted."""
+
+    def act(params, stack, new, base_key, tick, eps):
+        stack = jnp.concatenate([stack[:, 1:], new[:, None]], axis=1)
+        q = apply_fn(params, stack)
+        action = _rowwise_eps_greedy(q, tick_keys(base_key, tick,
+                                                  q.shape[0]), eps)
+        return stack, _pack_dqn(q, action)
+
+    return jax.jit(act, donate_argnums=(1,))
+
+
+def build_packed_act_rowkeys(apply_fn: Callable) -> Callable:
+    """Server-side variant of ``build_packed_act`` taking precomputed
+    per-row keys: the inference batcher concatenates rows from several
+    actors into one wide forward, so each row's key comes from ITS
+    actor's (base_key, tick, row) fold — identical streams to the local
+    paths regardless of batch composition."""
+
+    def act(params, obs, row_keys, eps):
+        q = apply_fn(params, obs)
+        return _pack_dqn(q, _rowwise_eps_greedy(q, row_keys, eps))
+
+    return jax.jit(act)
+
+
 def build_greedy_act(apply_fn: Callable) -> Callable:
     """Pure-greedy variant for evaluator/tester (reference evaluators.py:56-86
     runs eps=0 episodes)."""
@@ -96,6 +205,44 @@ def build_recurrent_epsilon_greedy_act(apply_fn: Callable) -> Callable:
         return jnp.where(explore, random_a, greedy), carry
 
     return jax.jit(act)
+
+
+def build_recurrent_packed_act(apply_fn: Callable, zero_carry) -> Callable:
+    """Fused recurrent act for the pipelined loop: the carry stays
+    DEVICE-RESIDENT across ticks (no per-tick host round-trip of the LSTM
+    state into the forward), and episode resets arrive as a per-row
+    boolean mask folded in on-device — row j's carry is replaced with the
+    model's zero carry before acting when ``reset_mask[j]`` is set, which
+    is exactly the host-side row reset the serial loop performed between
+    ticks.
+
+    ``zero_carry`` is the model's ``zero_carry(1)`` pytree (leading dim 1
+    broadcasts over rows).  Returns a jitted ``act(params, obs, carry,
+    reset_mask[B], base_key, tick, eps[B]) -> (action[B] int32, carry')``.
+    The caller owns the device carry and keeps a host copy for segment
+    storage (agents/recurrent_actor.py).
+
+    The carry argument is DONATED: carry' has exactly carry's shapes, so
+    XLA updates it in place — for the transformer family, whose carry IS
+    the rolling (B, window, *obs) context buffer, this is the ISSUE 4
+    "donate the obs buffer" optimisation (no per-tick reallocation of
+    the window).  Callers must treat the passed-in carry as consumed,
+    which the engine's swap-on-submit discipline guarantees."""
+    zero = jax.tree_util.tree_map(jnp.asarray, zero_carry)
+
+    def act(params, obs, carry, reset_mask, base_key, tick, eps):
+        def reset_rows(c, z):
+            mask = reset_mask.reshape(reset_mask.shape[0],
+                                      *([1] * (c.ndim - 1)))
+            return jnp.where(mask, z.astype(c.dtype), c)
+
+        carry = jax.tree_util.tree_map(reset_rows, carry, zero)
+        q, carry = apply_fn(params, obs, carry)
+        action = _rowwise_eps_greedy(
+            q, tick_keys(base_key, tick, q.shape[0]), eps)
+        return action.astype(jnp.int32), carry
+
+    return jax.jit(act, donate_argnums=(2,))
 
 
 def build_recurrent_greedy_act(apply_fn: Callable) -> Callable:

@@ -31,7 +31,8 @@ from typing import Any, Dict, List, Optional
 
 from pytorch_distributed_tpu.config import Options
 from pytorch_distributed_tpu.factory import (
-    EnvSpec, build_memory, get_worker, prebuild_native, probe_env,
+    EnvSpec, build_memory, get_worker, needs_inference_server,
+    prebuild_native, probe_env,
 )
 from pytorch_distributed_tpu.agents.clocks import (
     ActorStats, EvaluatorStats, GlobalClock, LearnerStats,
@@ -110,6 +111,19 @@ class Topology:
         self.evaluator_stats = EvaluatorStats()
         self.param_store = ParamStore(_count_params(opt, self.spec))
         self.handles = build_memory(opt, self.spec)
+        # actor_backend=batched: the shared inference batcher lives HERE
+        # — this process owns the accelerator (the learner runs in it),
+        # so the SEED-style wide actor forward shares the device with
+        # the learner's dispatches instead of burning actor-host CPUs
+        # (agents/inference.py)
+        self.inference_server = None
+        if needs_inference_server(opt):
+            from pytorch_distributed_tpu.agents.inference import (
+                InferenceServer,
+            )
+
+            self.inference_server = InferenceServer(
+                opt, self.spec, self.param_store)
         self._workers: List[Any] = []
         # populated by the process-backend monitor; the health plane
         # (fleet.py STATUS provider) reads per-slot budget remaining
@@ -130,9 +144,11 @@ class Topology:
             side = self.handles.actor_side
             if hasattr(side, "clone"):
                 side = side.clone()
+            client = (self.inference_server.make_client(i)
+                      if self.inference_server is not None else None)
             specs.append(("actor", i, (
                 opt, spec, i, side, self.param_store,
-                self.clock, self.actor_stats)))
+                self.clock, self.actor_stats, client)))
         if opt.agent_params.evaluator_nepisodes > 0:
             specs.append(("evaluator", 0, (
                 opt, spec, 0, None, self.param_store, self.clock,
@@ -220,6 +236,9 @@ class Topology:
                 t.start()
                 self._workers.append(t)
 
+        if self.inference_server is not None:
+            # after _worker_specs wired the clients, before anyone acts
+            self.inference_server.start()
         try:
             run_learner = get_worker("learner", opt.agent_type)
             run_learner(opt, self.spec, 0, self.handles.learner_side,
@@ -231,6 +250,10 @@ class Topology:
             if prev_term is not None:
                 signal.signal(signal.SIGTERM, prev_term)
             self._join_all()
+            if self.inference_server is not None:
+                # after the join: an actor draining its last tick may
+                # still be blocked in collect()
+                self.inference_server.stop()
             # transports feeding learner_side must shut before its queue
             # closes (FleetTopology stops its DCN gateway here)
             self._pre_close()
@@ -293,6 +316,18 @@ class Topology:
             if role == "actor":
                 budget.note_birth(ind)
         while not self.clock.stop.is_set():
+            srv = self.inference_server
+            if srv is not None and not srv.healthy():
+                # a dead inference server starves every batched actor;
+                # fail the run NOW instead of letting supervised actor
+                # restarts each block a full collect timeout against a
+                # thread that will never answer
+                print("[runtime] inference server died; stopping run")
+                recorder.record("inference-server-dead")
+                flight_recorder.dump_all(
+                    "inference server died; run stopped")
+                self.clock.stop.set()
+                return
             for i, (p, role, ind, args) in enumerate(list(self._proc_meta)):
                 if p.exitcode in (None, 0):
                     continue

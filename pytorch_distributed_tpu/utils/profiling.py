@@ -46,11 +46,18 @@ class StepTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self._acc[name] = self._acc.get(name, 0.0) + dt
-            if dt > self._max.get(name, 0.0):
-                self._max[name] = dt
-            self._n[name] = self._n.get(name, 0) + 1
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Accumulate an externally-timed duration — for callers that
+        need one measurement to land under several phase names (the
+        pipelined actor loop books dispatch+sync both under their own
+        phases and under the serial loop's ``act`` so dashboards stay
+        comparable across schedules)."""
+        self._acc[name] = self._acc.get(name, 0.0) + seconds
+        if seconds > self._max.get(name, 0.0):
+            self._max[name] = seconds
+        self._n[name] = self._n.get(name, 0) + 1
 
     def drain(self) -> Dict[str, float]:
         out = {}
