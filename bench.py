@@ -643,6 +643,94 @@ def bench_act_ab() -> dict:
     return {"act_ab": out} if out else {}
 
 
+def bench_health_overhead(windows: int = 6,
+                          updates_per_window: int = 512) -> dict:
+    """Health-sentinel guard cost (ISSUE 5 acceptance): the SAME fused
+    flagship learner program (batch-128 Nature-CNN over an HBM ring,
+    K=32 scanned updates per dispatch) measured with the in-jit finite
+    guard ON (production default: loss/grad/TD checked in-graph, state
+    select per leaf) vs OFF.  The guard must stay in-graph — no host
+    syncs on the hot path — so the acceptance bar is
+    ``health_overhead_frac`` < 0.02 of median step time.  Both variants
+    use the fetch-bounded window timing bench_micro documents (the
+    tunnel's async-dispatch mirage would hide the overhead too)."""
+    import jax
+
+    from pytorch_distributed_tpu.memory.device_replay import (
+        DeviceReplay, build_uniform_fused_step, round_capacity,
+    )
+    from pytorch_distributed_tpu.models import DqnCnnModel
+    from pytorch_distributed_tpu.ops.losses import (
+        build_dqn_train_step, init_train_state, make_optimizer,
+    )
+    from pytorch_distributed_tpu.utils.experience import Transition
+
+    B, K = MICRO_BATCH, MICRO_DISPATCH
+    model = DqnCnnModel(action_space=6, norm_val=255.0)
+    obs = np.zeros((1, 4, 84, 84), dtype=np.uint8)
+    params = model.init(jax.random.PRNGKey(0), obs)
+    tx = make_optimizer(lr=1e-4)
+
+    ring = DeviceReplay(capacity=round_capacity(2048, None),
+                        state_shape=(4, 84, 84), state_dtype=np.uint8)
+    rng = np.random.default_rng(0)
+    C = 512
+    for _ in range(ring.capacity // C):
+        ring.feed_chunk(Transition(
+            state0=rng.integers(0, 255, (C, 4, 84, 84)).astype(np.uint8),
+            action=rng.integers(0, 6, C).astype(np.int32),
+            reward=rng.normal(size=C).astype(np.float32),
+            gamma_n=np.full(C, 0.99 ** 5, dtype=np.float32),
+            state1=rng.integers(0, 255, (C, 4, 84, 84)).astype(np.uint8),
+            terminal1=(rng.random(C) < 0.1).astype(np.float32)))
+
+    key = jax.random.PRNGKey(0)
+
+    def measure(guard: bool) -> float:
+        nonlocal key
+        step = build_dqn_train_step(model.apply, tx,
+                                    target_model_update=250, guard=guard)
+        fused = build_uniform_fused_step(step, B, steps_per_call=K,
+                                         donate=False)
+        state = init_train_state(params, tx)
+
+        def keymat():
+            nonlocal key
+            key, sub = jax.random.split(key)
+            return jax.random.split(sub, K)
+
+        compiled = fused.lower(state, ring.state, keymat()).compile()
+        for _ in range(5):
+            state, metrics = compiled(state, ring.state, keymat())
+        float(jax.device_get(metrics["learner/critic_loss"]))
+        iters, rates = max(updates_per_window // K, 2), []
+        for _ in range(windows):
+            keysets = [keymat() for _ in range(iters)]
+            jax.block_until_ready(keysets[-1])
+            t0 = time.perf_counter()
+            for ks in keysets:
+                state, metrics = compiled(state, ring.state, ks)
+            float(jax.device_get(metrics["learner/critic_loss"]))
+            rates.append(iters * K / (time.perf_counter() - t0))
+        return float(np.median(rates))
+
+    unguarded = measure(False)
+    guarded = measure(True)
+    frac = (unguarded - guarded) / unguarded if unguarded > 0 else None
+    out = {
+        "updates_per_sec_guarded": round(guarded, 2),
+        "updates_per_sec_unguarded": round(unguarded, 2),
+        # clamped at 0: window noise routinely makes the guarded run
+        # measure FASTER on a noisy host; negative overhead is noise
+        "health_overhead_frac": (round(max(frac, 0.0), 4)
+                                 if frac is not None else None),
+        "steps_per_dispatch": K,
+        "batch_size": B,
+    }
+    print(f"[bench_health_overhead] {out}", file=sys.stderr, flush=True)
+    return {"health_overhead": out}
+
+
 def bench_actor_pipeline(envs: int = 16, ticks: int = 300) -> dict:
     """Actor hot-loop section (ISSUE 4): serial vs software-pipelined
     schedules on the production actor shape (pong-sim vector, Nature-CNN
@@ -853,7 +941,8 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("micro", "e2e", "both", "families",
-                                       "sampler", "act", "actor"),
+                                       "sampler", "act", "actor",
+                                       "health"),
                     default="both")
     ap.add_argument("--e2e-seconds", type=float, default=60.0)
     ap.add_argument("--e2e-actors", type=int, default=1)
@@ -884,6 +973,8 @@ def main() -> None:
         result.update(bench_sampler())
     if args.mode in ("both", "act"):
         result.update(bench_act_ab())
+    if args.mode in ("both", "health"):
+        result.update(bench_health_overhead())
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))

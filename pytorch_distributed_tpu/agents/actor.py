@@ -162,8 +162,19 @@ class _ActorHarness:
         self._next_sync = self.ap.actor_sync_freq
 
         from pytorch_distributed_tpu.utils import tracing
+        from pytorch_distributed_tpu.utils.faults import FaultInjector
         from pytorch_distributed_tpu.utils.metrics import MetricsWriter
         from pytorch_distributed_tpu.utils.profiling import StepTimer
+
+        # hang-watchdog liveness mark (utils/supervision.ProgressBoard,
+        # attached to the clock by the topology) + the actor fault plane
+        # (``ACTOR_FAULTS``, one frame per vector tick — ``hang@N``
+        # makes this worker stop progressing without exiting, the drill
+        # the watchdog must catch).  Test clocks may lack the surface.
+        self._bump_progress = getattr(clock, "bump_progress",
+                                      lambda label: None)
+        self._progress_label = f"actor-{process_ind}"
+        self._faults = FaultInjector.from_env("actor")
 
         self.timer = StepTimer("actor")
         self._timing_writer = MetricsWriter(
@@ -190,6 +201,8 @@ class _ActorHarness:
         N = self.num_envs
         self.env_steps += N
         self.clock.add_actor_steps(N)  # reference dqn_actor.py:166-167
+        self._bump_progress(self._progress_label)  # watchdog liveness
+        self._faults.data_frame(())  # ACTOR_FAULTS: hang@N / crash@N
         self._acc["total_nframes"] += N
         if self.env_steps >= self._next_sync:
             self._next_sync += self.ap.actor_sync_freq

@@ -36,6 +36,25 @@ class GlobalClock:
         # stalls the clock and every loop spins forever; here the runtime
         # sets this flag when any worker dies or the run completes.
         self.stop = _CTX.Event()
+        # Health-sentinel counters (utils/health.py): written by the
+        # learner, read by the T_STATUS health plane (fleet.py
+        # _health_snapshot -> tools/fleet_top.py) and by drills.
+        self.skipped_steps = _CTX.Value("l", 0, lock=True)
+        self.rollbacks = _CTX.Value("l", 0, lock=True)
+        # Hang-watchdog progress board (utils/supervision.ProgressBoard),
+        # attached by the owning Topology before workers spawn; the
+        # shared Values ride the clock's spawn pickle into every child.
+        self.progress = None
+
+    def bump_progress(self, label: str) -> None:
+        """Stamp a liveness-progress mark for ``label`` (e.g.
+        ``actor-3``); no-op when no watchdog board is attached."""
+        if self.progress is not None:
+            self.progress.bump(label)
+
+    def add_skipped_steps(self, n: int) -> None:
+        with self.skipped_steps.get_lock():
+            self.skipped_steps.value += n
 
     def add_actor_steps(self, n: int = 1) -> int:
         with self.actor_step.get_lock():

@@ -216,13 +216,19 @@ def run_evaluator(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         with snap_lock:
             return snapshots.popleft() if snapshots else None
 
+    # hang-watchdog liveness mark (utils/supervision.ProgressBoard):
+    # bumped on every poll and after every eval, so a stuck episode —
+    # not a merely starved evaluator — is what goes stale
+    bump = getattr(clock, "bump_progress", lambda label: None)
     try:
         while not clock.done(ap.steps):
+            bump("evaluator-0")
             snap = pop_snapshot()
             if snap is None:
                 time.sleep(0.1)
                 continue
             evaluate(*snap)
+            bump("evaluator-0")
         # final eval of the FINISHED weights (short runs may never have hit
         # the cadence; the run's acceptance signal must still be written):
         # always fetch fresh — a pending backlog snapshot can be up to

@@ -46,7 +46,8 @@ from pytorch_distributed_tpu.memory.device_sequence import (
 )
 from pytorch_distributed_tpu.memory.feeder import QueueOwner
 from pytorch_distributed_tpu.utils import checkpoint as ckpt
-from pytorch_distributed_tpu.utils import tracing
+from pytorch_distributed_tpu.utils import flight_recorder, health, tracing
+from pytorch_distributed_tpu.utils.faults import FaultInjector
 from pytorch_distributed_tpu.utils.metrics import MetricsWriter
 from pytorch_distributed_tpu.utils.profiling import StepTimer
 from pytorch_distributed_tpu.utils.rngs import np_rng
@@ -343,6 +344,9 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         else float("inf")
     while not clock.done(ap.steps) and memory_size(memory) <= learn_start \
             and time.monotonic() < deadline:
+        # replay starvation is a LEGITIMATE wait: keep the liveness mark
+        # fresh so the hang watchdog never reads warmup as a hang
+        clock.bump_progress("learner")
         time.sleep(0.05)
 
     # the latest step's metric refs, fetched to host only on the
@@ -380,6 +384,10 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             actor_step=int(clock.actor_step.value),
             best_eval_reward=float(clock.best_eval_reward.value),
             replay_size=int(getattr(memory, "size", 0)),
+            # sentinel provenance: how many rollbacks/skips preceded
+            # this epoch (ckpt_fsck context for post-rollback roots)
+            rollbacks=int(clock.rollbacks.value),
+            skipped_steps=int(clock.skipped_steps.value),
             rng=dict(
                 learner_host=ckpt.serialize_np_rng(rng),
                 learner_device=(ckpt.serialize_prng_key(device_key)
@@ -391,8 +399,89 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             memory=memory if opt.memory_params.checkpoint_replay else None,
             extras=extras, retain=ap.checkpoint_retain)
 
+    # ---- training health sentinel (utils/health.py): the in-jit guard
+    # already skips non-finite steps inside the train program; here the
+    # host side watches the metrics stream for SUSTAINED divergence
+    # (consecutive anomalous stats windows) and rolls the whole triple —
+    # params, opt state, replay, clocks, RNG — back to the last good
+    # checkpoint epoch in-process, bounded by ``max_rollbacks`` before
+    # failing fast.  ``LEARNER_FAULTS`` (poison_grad@N / hang@N) drills
+    # the ladder deterministically (utils/faults.py).
+    hp = health.resolve(opt.health_params)
+    detector = health.AnomalyDetector(zmax=hp.anomaly_zmax,
+                                      grad_spike=hp.grad_spike,
+                                      threshold=hp.anomaly_threshold)
+    recorder = flight_recorder.get_recorder("learner")
+    _linj = FaultInjector.from_env("learner")
+    _poison = [False]   # a pending poison_grad verb (next host batch)
+    _win_skips = [0]    # exact skip count this stats window (host paths)
+    _last_td = [None]   # mean |TD| of the last applied host-PER step
+    _rb = {"used": 0, "before": None}  # rollback budget + ladder position
+
+    def _fatal_divergence(msg: str) -> None:
+        recorder.record("divergence-fatal", step=lstep, detail=msg)
+        flight_recorder.dump_all(f"learner divergence: {msg}")
+        raise RuntimeError(f"[health] {msg}")
+
+    def _rollback(reason: str) -> None:
+        """Restore the last good epoch in-process and resume.  Each
+        successive rollback targets an epoch strictly OLDER than the
+        previous restore point (the newest epoch may itself hold
+        already-diverged params), and every committed epoch newer than
+        the target is fenced with a ROLLED_BACK marker so neither this
+        run nor a later --resume can step back onto it."""
+        nonlocal state, lstep, lstep0, device_key, key_buf
+        if _rb["used"] >= hp.max_rollbacks:
+            _fatal_divergence(
+                f"divergence persists after {_rb['used']} rollback(s) "
+                f"(max_rollbacks={hp.max_rollbacks}): {reason}")
+        target = ckpt.resolve_epoch(opt.model_name, before=_rb["before"])
+        if target is None:
+            _fatal_divergence(
+                f"sustained divergence ({reason}) with no resumable "
+                f"checkpoint epoch to roll back to "
+                f"(checkpoint_freq=0 or all epochs spent)")
+        ckpt.fence_epochs_after(opt.model_name, target.epoch,
+                                reason=reason)
+        state = learner.place(
+            ckpt.load_epoch_state(target, jax.device_get(state)))
+        if opt.memory_params.checkpoint_replay and target.has_replay:
+            rows = ckpt.load_epoch_replay(target, memory)
+            if rows:
+                print(f"[health] replay rolled back with the epoch: "
+                      f"{rows} rows")
+        lstep = (target.learner_step if target.learner_step >= 0
+                 else int(jax.device_get(state.step)))
+        lstep0 = int(target.extras.get("lstep0", lstep))
+        ckpt.restore_np_rng(rng,
+                            target.extras.get("rng", {}).get("learner_host"))
+        if on_device:
+            saved = target.extras.get("rng", {}).get("learner_device")
+            if saved:
+                device_key = ckpt.deserialize_prng_key(saved, device_key)
+            key_buf.clear()  # pre-split keys belong to the abandoned tail
+        clock.set_learner_step(lstep)
+        with clock.rollbacks.get_lock():
+            clock.rollbacks.value += 1
+        _rb["used"] += 1
+        _rb["before"] = target.epoch
+        detector.reset()
+        _win_skips[0] = 0  # pre-rollback skips belong to the dead tail
+        recorder.record("rollback", epoch=target.epoch, step=lstep,
+                        reason=reason, used=_rb["used"])
+        flight_recorder.dump_all(
+            f"health rollback #{_rb['used']} to epoch {target.epoch} "
+            f"({reason})")
+        print(f"[health] rolled back to epoch {target.epoch} "
+              f"(step {lstep}) after {reason}; "
+              f"{hp.max_rollbacks - _rb['used']} rollback(s) left",
+              flush=True)
+
     while lstep < ap.steps and not clock.stop.is_set() \
             and time.monotonic() < deadline:
+        clock.bump_progress("learner")
+        for _action, _arg in _linj.data_frame(("poison_grad",)):
+            _poison[0] = True
         if ap.max_replay_ratio > 0:
             # pacing gate: don't draw more than max_replay_ratio samples
             # per collected transition (config.py AgentParams docstring).
@@ -407,10 +496,18 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                    > ap.max_replay_ratio * max(clock.actor_step.value, 1)):
                 if hasattr(memory, "drain"):
                     memory.drain()
+                # pacing throttle = flow control, not a hang
+                clock.bump_progress("learner")
                 time.sleep(0.002)
             if clock.stop.is_set():
                 break
         if on_device:
+            if _poison[0]:
+                _poison[0] = False
+                print("[faults:learner] poison_grad targets the "
+                      "host-sampled batch; inert on the fused device "
+                      "path (drill with poison_chunk instead)",
+                      flush=True)
             with timer.phase("drain"):
                 memory.drain()
             if not key_buf:
@@ -441,13 +538,41 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                     tracer.span("sample",
                                 trace_id=tracing.current_trace()):
                 batch = memory.sample(ap.batch_size, rng)
+            if _poison[0]:
+                # poison_grad drill: a non-finite loss injected into
+                # THIS update — the in-jit guard must skip it with
+                # params provably unchanged (tests/test_health.py)
+                _poison[0] = False
+                batch = batch._replace(reward=np.full_like(
+                    np.asarray(batch.reward), np.nan))
+                print("[faults:learner] poison_grad: NaN rewards "
+                      "injected into this update's batch", flush=True)
             with timer.phase("step"), \
                     tracer.span("learn", trace_id=tracing.current_trace()):
                 state, metrics, td_abs = learner.step(state, batch)
+            skipped_now = 0.0
+            if is_per and isinstance(metrics, dict) \
+                    and health.SKIPPED_KEY in metrics:
+                # the PER path must know NOW (write-back suppression)
+                # and already syncs td_abs to host — one extra scalar
+                # rides the same sync, giving exact per-step skip
+                # accounting.  Uniform paths keep full async dispatch
+                # and sample the flag on the stats cadence instead.
+                skipped_now = float(jax.device_get(
+                    metrics[health.SKIPPED_KEY]))
+                if skipped_now >= 0.5:
+                    _win_skips[0] += 1
             if is_per:
                 with timer.phase("priorities"):
-                    memory.update_priorities(np.asarray(batch.index),
-                                             np.asarray(td_abs))
+                    if skipped_now < 0.5:
+                        td_np = np.asarray(td_abs)
+                        # |TD| scale feeds the anomaly detector's
+                        # td_explosion signal on the stats cadence
+                        _last_td[0] = float(np.mean(np.abs(td_np)))
+                        memory.update_priorities(np.asarray(batch.index),
+                                                 td_np)
+                    # skipped step: the guard zeroed td_abs — writing it
+                    # back would crush real priorities to epsilon
         stride = K if on_device else 1
         prev = lstep
         lstep += stride
@@ -479,6 +604,48 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                 steps_per_sec=(lstep - last_stats_lstep)
                 / max(now - t_cadence, 1e-9),
             )
+            # ---- sentinel window: guard skips + rolling anomalies ----
+            # host PER counted every step (_win_skips); other paths read
+            # the sampled flag of the window's last step/dispatch (the
+            # fused path's flag already sums over its K substeps,
+            # utils/health.reduce_scan_metrics)
+            skipped_w = float(_win_skips[0]) or vals.get(
+                health.SKIPPED_KEY, 0.0)
+            _win_skips[0] = 0
+            if skipped_w:
+                clock.add_skipped_steps(int(round(skipped_w)))
+            # PER extras for the detector: |TD| scale from the last
+            # applied step (host PER syncs it anyway) and the sum
+            # tree's total priority mass — a collapse to ~0 means every
+            # sample draws the same handful of rows.  Device rings keep
+            # their mass on-chip; fetching it would be a host sync, so
+            # those paths lean on the loss/grad/skip signals instead.
+            pmass, prows = None, 0
+            per_mem = getattr(memory, "memory", None) if is_per else None
+            if per_mem is not None and hasattr(per_mem, "sum_tree"):
+                pmass = float(per_mem.sum_tree.total())
+                prows = int(per_mem.size)
+            anomalies = detector.observe(
+                loss=vals.get("learner/critic_loss"),
+                grad_norm=vals.get("learner/grad_norm"),
+                td_mean=_last_td[0],
+                priority_mass=pmass,
+                replay_rows=prows,
+                skipped=skipped_w)
+            if anomalies:
+                recorder.record("anomaly", step=lstep, kinds=anomalies,
+                                streak=detector.streak)
+                print(f"[health] anomaly at step {lstep}: "
+                      f"{'+'.join(anomalies)} (streak {detector.streak}"
+                      f"/{hp.anomaly_threshold})", flush=True)
+            timing_writer.scalars({
+                "health/skipped_steps": float(clock.skipped_steps.value),
+                "health/rollbacks": float(clock.rollbacks.value),
+                "health/anomaly_streak": float(detector.streak),
+            }, step=lstep)
+            if hp.rollback and detector.should_rollback():
+                _rollback("+".join(anomalies) if anomalies
+                          else "anomaly streak")
             timing_writer.scalars(timer.drain(), step=lstep)
             _flush_traces(lstep)
             t_cadence = now

@@ -305,6 +305,50 @@ def build_agent_params(agent_type: str, **overrides: Any) -> AgentParams:
 
 
 @dataclass
+class HealthParams:
+    """Training health sentinel knobs (utils/health.py; no reference
+    equivalent — the reference has no numeric/liveness protection at
+    all).  Every field is env-overridable as
+    ``TPU_APEX_HEALTH_<FIELD>`` (``health.resolve``), the same
+    spawn-inheritance contract the fault planes use, so drills flip
+    knobs without plumbing."""
+
+    # In-jit finite check on loss/grad-norm/TD: a non-finite step is
+    # skipped in-graph (params/opt-state pass through unchanged, PER
+    # write-back suppressed) and counted as ``learner/skipped``.
+    numeric_guards: bool = True
+    # Host-side rolling anomaly detector, evaluated on the learner's
+    # stats cadence: loss EWMA z-score bound, grad-norm/|TD| spike
+    # ratio vs their own EWMAs, and the consecutive-anomalous-window
+    # streak that triggers a rollback.
+    anomaly_zmax: float = 8.0
+    grad_spike: float = 100.0
+    anomaly_threshold: int = 3
+    # Automatic in-process rollback to the last good checkpoint epoch on
+    # sustained divergence (needs committed epochs: checkpoint_freq > 0
+    # or a preemption save).  ``max_rollbacks`` bounds the budget before
+    # the learner escalates to a fatal exit; each successive rollback
+    # targets one epoch OLDER than the previous one's restore point
+    # (the newest epoch may itself hold already-diverged params).
+    rollback: bool = True
+    max_rollbacks: int = 2
+    # Ingest quarantine: validate chunks at the single-owner ingest
+    # boundaries and write offenders to {log_dir}/quarantine/ instead of
+    # replay (also gated process-wide by TPU_APEX_QUARANTINE).
+    quarantine: bool = True
+    quarantine_max_files: int = 64
+    # Hang watchdog: seconds a worker may go without a progress mark
+    # before the supervisor SIGKILLs and respawns it (EXIT_HUNG, paid
+    # from the slot's RestartBudget).  0 disables the watchdog (the
+    # default: a safe deadline depends on the host's compile times —
+    # production fleets should set it to a few multiples of their
+    # longest legitimate stall, e.g. 180).  ``hang_grace`` extends the
+    # deadline before a worker's FIRST mark, covering jit compiles.
+    hang_deadline: float = 0.0
+    hang_grace: float = 120.0
+
+
+@dataclass
 class ParallelParams:
     """TPU topology knobs — no reference equivalent (the reference is a
     single-node torch.multiprocessing program, SURVEY.md §2); this is where
@@ -376,6 +420,7 @@ class Options:
     model_params: ModelParams = field(default_factory=ModelParams)
     agent_params: AgentParams = field(default_factory=AgentParams)
     parallel_params: ParallelParams = field(default_factory=ParallelParams)
+    health_params: HealthParams = field(default_factory=HealthParams)
 
     @property
     def model_dir(self) -> str:
@@ -467,7 +512,7 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
         assert key not in selectors  # popped above
         routed = False
         for sub in ("env_params", "memory_params", "model_params",
-                    "agent_params", "parallel_params"):
+                    "agent_params", "parallel_params", "health_params"):
             subobj = getattr(opt, sub)
             if hasattr(subobj, key):
                 setattr(subobj, key, val)

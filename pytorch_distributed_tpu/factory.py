@@ -410,9 +410,14 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
         build_dqn_train_step, init_ddpg_train_state, init_train_state,
         make_optimizer,
     )
+    from pytorch_distributed_tpu.utils import health
 
     ap = opt.agent_params
     decay = ap.steps if ap.lr_decay else 0
+    # in-jit numeric guards (utils/health.py finite_guard): on by
+    # default, killable via HealthParams.numeric_guards / the
+    # TPU_APEX_HEALTH_NUMERIC_GUARDS env override
+    guard = health.resolve(opt.health_params).numeric_guards
     if opt.agent_type == "r2d2":
         from pytorch_distributed_tpu.ops.sequence_losses import (
             build_drqn_train_step,
@@ -436,6 +441,7 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
             target_model_update=ap.target_model_update,
             rescale_values=ap.value_rescale,
             priority_eta=ap.priority_eta,
+            guard=guard,
         )
         if opt.model_type.startswith("dtqn"):
             from pytorch_distributed_tpu.ops.sequence_losses import (
@@ -526,6 +532,7 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
             train_apply, tx,
             enable_double=ap.enable_double,
             target_model_update=ap.target_model_update,
+            guard=guard,
         )
         return state, step
 
@@ -537,6 +544,7 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
             step = build_ddpg_train_step_coupled(
                 actor_apply, critic_apply, tx,
                 target_model_update=ap.target_model_update,
+                guard=guard,
             )
         else:
             atx = make_optimizer(ap.lr, ap.clip_grad, lr_decay_steps=decay)
@@ -546,6 +554,7 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
             step = build_ddpg_train_step(
                 actor_apply, critic_apply, atx, ctx_,
                 target_model_update=ap.target_model_update,
+                guard=guard,
             )
         return state, step
 

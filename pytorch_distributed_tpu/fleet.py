@@ -140,6 +140,23 @@ class FleetTopology(Topology):
         if prev is not None and now > prev[0]:
             h["learner_steps_per_sec"] = round(
                 (step - prev[1]) / (now - prev[0]), 3)
+        # health-sentinel counters (utils/health.py): learner-side guard
+        # skips and rollbacks ride the shared clock; quarantine counts
+        # come from this process's registry (the learner-side ingest
+        # boundaries — the gateway's own per-slot counts are already in
+        # the base snapshot); hang kills from the runtime watchdog
+        from pytorch_distributed_tpu.utils import health
+
+        h["health_sentinel"] = {
+            "skipped_steps": int(self.clock.skipped_steps.value),
+            "rollbacks": int(self.clock.rollbacks.value),
+            "hang_kills": int(self.hang_kills),
+            # gateway-* sources are excluded: the gateway's own per-slot
+            # dict (base snapshot "quarantined") already carries them
+            "quarantined_local": {
+                s: n for s, n in health.quarantine_counts().items()
+                if not s.startswith("gateway-")},
+        }
         budget = self._restart_budget
         if budget is not None:
             # scope is honest in the name: the runtime monitor only
@@ -192,8 +209,8 @@ def run_fleet_learner(opt: Options, local_actors: int = 0, port: int = 5555,
 # actor host
 # ---------------------------------------------------------------------------
 
-def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
-                       ) -> None:
+def _remote_actor_main(opt: Options, coordinator: str, process_ind: int,
+                       progress=None) -> None:
     """One remote rollout worker: DCN adapters in place of the shared-memory
     plane, then the standard actor loop (agents/actor.py) unmodified.
 
@@ -237,6 +254,10 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int
         sys.exit(EXIT_DISCONNECTED)
     memory = RemoteMemory(client)
     clock = RemoteClock(client)
+    # hang-watchdog liveness: the actor harness bumps
+    # clock.bump_progress per vector tick; the shared board's marks are
+    # read by run_fleet_actors' supervisor (utils/supervision.py)
+    clock.progress = progress
     try:
         spec = probe_env(opt)
         get_worker("actor", opt.agent_type)(
@@ -289,15 +310,27 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
         f"fleet num_actors={opt.num_actors}")
 
     from pytorch_distributed_tpu.factory import prebuild_native
+    from pytorch_distributed_tpu.utils import health
+    from pytorch_distributed_tpu.utils.supervision import ProgressBoard
 
     prebuild_native(opt)  # once, before N workers race the same g++
+
+    # hang watchdog (health sentinel): per-slot liveness marks bumped by
+    # the remote actors' RemoteClock; stale marks past hang_deadline get
+    # the worker SIGKILLed and respawned as EXIT_HUNG from the same
+    # RestartBudget as a crash.  Process backend only (threads cannot be
+    # killed); hang_deadline=0 (default) disables the pass.
+    hp = health.resolve(opt.health_params)
+    board = ProgressBoard([f"actor-{actor_base + i}"
+                           for i in range(actor_count)])
 
     thread_exits: dict = {}  # slot -> nonzero exit (thread backend only)
 
     def spawn(ind: int):
+        board.note_start(f"actor-{ind}")
         if backend == "process":
             w = _CTX.Process(target=_remote_actor_main,
-                             args=(opt, coordinator, ind),
+                             args=(opt, coordinator, ind, board),
                              name=f"fleet-actor-{ind}", daemon=True)
         else:
             def _thread_main(ind=ind):
@@ -340,7 +373,7 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
 
     from pytorch_distributed_tpu.utils import flight_recorder
     from pytorch_distributed_tpu.utils.supervision import (
-        RestartBudget, describe_exit,
+        EXIT_HUNG, RestartBudget, describe_exit,
     )
 
     flight_recorder.configure(opt.log_dir, export_env=True)
@@ -396,6 +429,42 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
                                      exit=w.exitcode)
                 del workers[ind]
                 abandoned.append(ind)
+        # ---- hang watchdog: SIGKILL alive-but-stuck actors (no
+        # progress mark within hang_deadline; compile grace respected)
+        # and respawn them through the RestartBudget as EXIT_HUNG
+        if hp.hang_deadline > 0:
+            hung = set(board.hung(hp.hang_deadline, hp.hang_grace,
+                                  only=[f"actor-{i}" for i in workers]))
+            for ind, w in list(workers.items()):
+                if f"actor-{ind}" not in hung or not w.is_alive():
+                    continue
+                host_recorder.record(
+                    "worker-hung", slot=ind,
+                    age=round(board.age(f"actor-{ind}"), 1))
+                flight_recorder.dump_all(
+                    f"actor-{ind} hung (> {hp.hang_deadline:g}s without "
+                    f"progress); watchdog SIGKILL")
+                w.kill()
+                w.join(10.0)
+                delay = budget.request_restart(ind)
+                if delay is not None:
+                    print(f"[fleet] actor-{ind} "
+                          f"({describe_exit(EXIT_HUNG)}); restart "
+                          f"{budget.count(ind)}/{max_restarts} "
+                          f"in {delay:.0f}s")
+                    host_recorder.record("worker-restarted", slot=ind,
+                                         exit=EXIT_HUNG,
+                                         restarts=budget.count(ind),
+                                         delay=delay)
+                    del workers[ind]
+                    pending[ind] = now + delay
+                else:
+                    print(f"[fleet] actor-{ind} out of restart budget "
+                          f"(hung); abandoning slot")
+                    host_recorder.record("slot-abandoned", slot=ind,
+                                         exit=EXIT_HUNG)
+                    del workers[ind]
+                    abandoned.append(ind)
         if abandoned:
             # fail fast like the single-host monitor (runtime._monitor
             # trips the stop event on the same condition): a host running

@@ -178,11 +178,21 @@ class DevicePerReplay(DeviceReplay):
         alpha = self.alpha
         draw_fn = self._draw_fn
 
+        from pytorch_distributed_tpu.utils.health import (
+            SKIPPED_KEY, reduce_scan_metrics, suppress_writeback,
+        )
+
         def one(ts, rs: PerReplayState, key, beta):
             batch = per_sample(rs, key, batch_size, beta, sample_fn=draw_fn)
             ts, metrics, td_abs = train_step(ts, batch)
-            rs = per_update_priorities(rs, batch.index, td_abs, alpha)
-            return ts, rs, metrics
+            rs_new = per_update_priorities(rs, batch.index, td_abs, alpha)
+            skipped = (metrics.get(SKIPPED_KEY)
+                       if isinstance(metrics, dict) else None)
+            if skipped is not None:
+                # guarded step: a skipped (non-finite) substep must not
+                # scatter its zeroed TD over real priorities either
+                rs_new = suppress_writeback(skipped, rs_new, rs)
+            return ts, rs_new, metrics
 
         if steps_per_call <= 1:
             return jax.jit(one, donate_argnums=(0, 1) if donate else ())
@@ -194,7 +204,7 @@ class DevicePerReplay(DeviceReplay):
                 return (ts, rs), metrics
 
             (ts, rs), metrics = jax.lax.scan(body, (ts, rs), keys)
-            return ts, rs, jax.tree_util.tree_map(lambda x: x[-1], metrics)
+            return ts, rs, reduce_scan_metrics(metrics)
 
         return jax.jit(multi, donate_argnums=(0, 1) if donate else ())
 

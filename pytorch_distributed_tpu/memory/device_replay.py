@@ -145,7 +145,14 @@ def build_uniform_fused_step(step_fn, batch_size: int,
             return ts, metrics
 
         ts, metrics = jax.lax.scan(one, ts, keys)
-        return ts, jax.tree_util.tree_map(lambda x: x[-1], metrics)
+        # last substep's metrics stand in for the dispatch, EXCEPT the
+        # guard's skip counter, which sums over the scan
+        # (utils/health.py reduce_scan_metrics)
+        from pytorch_distributed_tpu.utils.health import (
+            reduce_scan_metrics,
+        )
+
+        return ts, reduce_scan_metrics(metrics)
 
     return jax.jit(multi, donate_argnums=(0,) if donate else ())
 
@@ -308,6 +315,7 @@ class DeviceReplayIngest:
         self.replay: Optional[DeviceReplay] = None
         self._pending: list = []
         self._fed_total = 0
+        self._validator = None  # ingest quarantine, built on first drain
 
     def make_feeder(self, chunk: int = 16):
         from pytorch_distributed_tpu.memory.feeder import QueueFeeder
@@ -373,15 +381,32 @@ class DeviceReplayIngest:
               max_rows: int = 32768) -> int:
         """Move queued transitions into HBM; bounded by ``max_rows`` per
         call so a deep backlog cannot stall the learner's update cadence —
-        leftover rows carry to the next step's drain."""
+        leftover rows carry to the next step's drain.
+
+        Also the single-owner ingest boundary for the HBM rings, so the
+        health sentinel's quarantine runs here (utils/health.py): a
+        non-finite or schema-drifted row diverted to
+        ``{log_dir}/quarantine/`` instead of being scattered into a ring
+        every future minibatch samples from — and instead of crashing
+        the learner's np.stack below on a shape drift."""
         from pytorch_distributed_tpu.memory.feeder import pop_chunks
+        from pytorch_distributed_tpu.utils import health, tracing
         from pytorch_distributed_tpu.utils.experience import (
             transition_dtypes,
         )
 
         assert self.replay is not None, "attach() first"
-        self._pending.extend(
-            t for t, _priority in pop_chunks(self._q, max_chunks))
+        items = pop_chunks(self._q, max_chunks)
+        if items and health.quarantine_active():
+            if self._validator is None:
+                self._validator = health.ChunkValidator(
+                    state_shape=self.state_shape,
+                    state_dtype=self.state_dtype)
+            items, bad = self._validator.filter(items)
+            if bad:
+                health.get_quarantine("feeder-device").put(
+                    bad, trace_id=tracing.current_trace())
+        self._pending.extend(t for t, _priority in items)
         fed = 0
         dt = transition_dtypes(self.replay.state_dtype,
                                self.replay.action_dtype)

@@ -246,11 +246,21 @@ class DeviceSequenceReplay:
         unamortised dispatch costs ~1.4 ms, see bench.py)."""
         alpha = self.alpha
 
+        from pytorch_distributed_tpu.utils.health import (
+            SKIPPED_KEY, reduce_scan_metrics, suppress_writeback,
+        )
+
         def one(ts, rs: SeqReplayState, key, beta):
             batch = seq_sample(rs, key, batch_size, beta)
             ts, metrics, seq_pr = train_step(ts, batch)
-            rs = seq_update_priorities(rs, batch.index, seq_pr, alpha)
-            return ts, rs, metrics
+            rs_new = seq_update_priorities(rs, batch.index, seq_pr, alpha)
+            skipped = (metrics.get(SKIPPED_KEY)
+                       if isinstance(metrics, dict) else None)
+            if skipped is not None:
+                # a guard-skipped substep's zeroed priorities must not
+                # overwrite the ring's real ones (utils/health.py)
+                rs_new = suppress_writeback(skipped, rs_new, rs)
+            return ts, rs_new, metrics
 
         if steps_per_call <= 1:
             return jax.jit(one, donate_argnums=(0, 1) if donate else ())
@@ -262,7 +272,7 @@ class DeviceSequenceReplay:
                 return (ts, rs), metrics
 
             (ts, rs), metrics = jax.lax.scan(body, (ts, rs), keys)
-            return ts, rs, jax.tree_util.tree_map(lambda x: x[-1], metrics)
+            return ts, rs, reduce_scan_metrics(metrics)
 
         return jax.jit(multi, donate_argnums=(0, 1) if donate else ())
 

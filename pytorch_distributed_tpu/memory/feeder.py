@@ -45,6 +45,7 @@ class QueueFeeder:
         self._stop = None
         self._timeout_put = False
         self._tracer: Optional[tracing.Tracer] = None
+        self._faults = None  # FEEDER_FAULTS injector, built lazily
 
     def clone(self) -> "QueueFeeder":
         """Same queue, fresh chunk buffer — thread-backend workers each get
@@ -60,11 +61,24 @@ class QueueFeeder:
         self._tracer = tracer
 
     def __getstate__(self):
-        # tracers hold threading locks: never ride a spawn pickle — the
-        # child attaches its own role tracer after unpickling
+        # tracers and fault injectors hold threading locks: never ride a
+        # spawn pickle — the child attaches its own role tracer after
+        # unpickling and rebuilds the injector from FEEDER_FAULTS
+        # (spawn children inherit the env, utils/faults.py)
         d = self.__dict__.copy()
         d["_tracer"] = None
+        d["_faults"] = None
         return d
+
+    def _injector(self):
+        """The feeder fault plane (``FEEDER_FAULTS``, utils/faults.py):
+        one frame per flush, so ``poison_chunk@N`` poisons exactly the
+        Nth chunk this process ships."""
+        if self._faults is None:
+            from pytorch_distributed_tpu.utils.faults import FaultInjector
+
+            self._faults = FaultInjector.from_env("feeder")
+        return self._faults
 
     def set_stop(self, event) -> None:
         """Make flush() abort (dropping its buffer) once ``event`` is set:
@@ -99,6 +113,15 @@ class QueueFeeder:
     def flush(self) -> None:
         if not self._buf:
             return
+        for _action, _arg in self._injector().data_frame(("poison_chunk",)):
+            # poison_chunk drill: NaN rewards / garbage priorities (and
+            # NaN obs for float states) — the learner-side ingest
+            # quarantine must catch this chunk (utils/health.py)
+            from pytorch_distributed_tpu.utils import health
+
+            self._buf = list(health.poison_items(self._buf))
+            print("[faults:feeder] poison_chunk: chunk poisoned before "
+                  "flush", flush=True)
         traced = tracing.active()  # TPU_APEX_TRACE=0: plain list, no
         chunk = (tracing.TracedChunk(self._buf)  # mint, no wire columns
                  if traced else self._buf)
@@ -153,16 +176,39 @@ class QueueOwner:
         self.memory = memory
         self.max_queue_chunks = max_queue_chunks  # backpressure bound
         self._q = _CTX.Queue(max_queue_chunks)
+        self._validator = None  # ingest quarantine, built on first drain
 
     def make_feeder(self, chunk: int = 16) -> QueueFeeder:
         return QueueFeeder(self._q, chunk)
 
     def drain(self, max_chunks: int = 1024) -> int:
-        """Pull pending chunks into the memory; returns transitions fed."""
+        """Pull pending chunks into the memory; returns transitions
+        POPPED from the queue (fed + quarantined — drain-to-empty loops
+        key on popped, so an all-quarantined batch never reads as
+        "queue dry").
+
+        This is the single-owner ingest boundary, so the health
+        sentinel's quarantine runs here (utils/health.py): non-finite
+        obs/reward/priority and shape/dtype drift are diverted to
+        ``{log_dir}/quarantine/`` instead of entering replay — one bad
+        chunk must never poison what every future minibatch samples
+        from."""
+        from pytorch_distributed_tpu.utils import health
+
         items = pop_chunks(self._q, max_chunks)
+        popped = len(items)  # drain-to-empty loops key on POPPED, not
+        # fed: an all-quarantined batch must not read as "queue dry"
+        if items and health.quarantine_active():
+            if self._validator is None:
+                self._validator = health.ChunkValidator.for_memory(
+                    self.memory)
+            items, bad = self._validator.filter(items)
+            if bad:
+                health.get_quarantine("feeder-local").put(
+                    bad, trace_id=tracing.current_trace())
         for transition, priority in items:
             self.memory.feed(transition, priority)
-        return len(items)
+        return popped
 
     # -- checkpoint: drain then delegate ------------------------------------
 

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import optax
 
 from pytorch_distributed_tpu.utils.experience import Batch
+from pytorch_distributed_tpu.utils.health import finite_guard
 from pytorch_distributed_tpu.utils.helpers import global_norm, update_target
 
 PyTree = Any
@@ -102,11 +103,15 @@ def build_dqn_train_step(
     target_model_update: float = 250,
     huber: bool = False,
     axis_name: str | None = None,
+    guard: bool = True,
 ) -> Callable[[TrainState, Batch],
               Tuple[TrainState, Dict[str, jnp.ndarray], jnp.ndarray]]:
     """Returns the DQN update step ``(state, batch) -> (state, metrics,
     td_abs)`` (reference dqn_learner.py:55-95 as one XLA program); ``td_abs``
-    feeds PER priority write-back."""
+    feeds PER priority write-back.  ``guard`` (default on) wraps the step
+    with the in-jit finite check (utils/health.finite_guard): a
+    non-finite step passes the state through unchanged and reports
+    ``learner/skipped`` instead of poisoning Adam."""
 
     def step(state: TrainState, batch: Batch):
         def loss_fn(params):
@@ -142,7 +147,7 @@ def build_dqn_train_step(
         return (TrainState(params, target_params, opt_state, new_step),
                 metrics, td_abs)
 
-    return step
+    return finite_guard(step) if guard else step
 
 
 def init_ddpg_train_state(
@@ -172,6 +177,7 @@ def build_ddpg_train_step(
     target_model_update: float = 1e-3,
     huber: bool = False,
     axis_name: str | None = None,
+    guard: bool = True,
 ) -> Callable:
     """Decoupled DDPG update: separate critic and actor gradient steps with
     per-net optimizers (textbook DDPG; see module docstring re the
@@ -236,7 +242,7 @@ def build_ddpg_train_step(
                            new_step),
                 metrics, td_abs)
 
-    return step
+    return finite_guard(step) if guard else step
 
 
 def build_ddpg_train_step_coupled(
@@ -247,6 +253,7 @@ def build_ddpg_train_step_coupled(
     target_model_update: float = 1e-3,
     huber: bool = False,
     axis_name: str | None = None,
+    guard: bool = True,
 ) -> Callable:
     """Reference-faithful coupled DDPG update: one optimizer over the full
     param tree, one gradient step of ``policy_loss + critic_loss`` — so the
@@ -285,7 +292,7 @@ def build_ddpg_train_step_coupled(
         return (TrainState(params, new_target, opt_state, new_step),
                 metrics, td_abs)
 
-    return step
+    return finite_guard(step) if guard else step
 
 
 # ---------------------------------------------------------------------------

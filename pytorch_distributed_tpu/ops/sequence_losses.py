@@ -37,6 +37,7 @@ import optax
 
 from pytorch_distributed_tpu.memory.sequence_replay import SegmentBatch
 from pytorch_distributed_tpu.ops.losses import TrainState
+from pytorch_distributed_tpu.utils.health import finite_guard
 from pytorch_distributed_tpu.utils.helpers import global_norm, update_target
 
 PyTree = Any
@@ -168,6 +169,7 @@ def build_drqn_train_step(
     priority_eta: float = 0.9,
     axis_name: str | None = None,
     packed_frames: int = 0,
+    guard: bool = True,
 ) -> Callable[[TrainState, SegmentBatch],
               Tuple[TrainState, Dict[str, jnp.ndarray], jnp.ndarray]]:
     """Returns ``(state, batch) -> (state, metrics, seq_priorities)``.
@@ -224,7 +226,7 @@ def build_drqn_train_step(
         return _apply_update(state, grads, loss, seq_pr, q_mean, tx,
                              target_model_update)
 
-    return step
+    return finite_guard(step) if guard else step
 
 
 def build_dtqn_train_step(
@@ -241,6 +243,7 @@ def build_dtqn_train_step(
     axis_name: str | None = None,
     aux_weight: float = 0.0,
     target_window_apply: Callable | None = None,
+    guard: bool = True,
 ) -> Callable[[TrainState, SegmentBatch],
               Tuple[TrainState, Dict[str, jnp.ndarray], jnp.ndarray]]:
     """Transformer (DTQN) sequence update: same contract as
@@ -306,4 +309,4 @@ def build_dtqn_train_step(
         return _apply_update(state, grads, loss, seq_pr, q_mean, tx,
                              target_model_update, extra)
 
-    return step
+    return finite_guard(step) if guard else step

@@ -154,7 +154,13 @@ _FIELDS = ("state0", "action", "reward", "gamma_n", "state1", "terminal1")
 
 def encode_chunk(items: List[Tuple[Transition, Optional[float]]]) -> bytes:
     """Stack a chunk of (transition, priority) into one savez payload.
-    ``priority`` None (uniform / new-sample-max semantics) encodes as NaN.
+    ``priority`` None (uniform / new-sample-max semantics) travels as an
+    explicit ``priority_ok`` validity column — NOT as a NaN sentinel:
+    a genuine NaN priority from a diverged actor used to silently decode
+    as None ("give it the new-sample max"), the exact corruption the
+    ingest quarantine exists to catch; with the validity column a NaN
+    survives the wire as the NaN it is and is quarantined at the
+    gateway.  (Decode still accepts sentinel-era frames from old peers.)
     A ``tracing.TracedChunk`` carries its trace id + birth wall-clock as
     two extra columns (still no pickle on the wire), so the trace minted
     at the actor survives the hop to the gateway."""
@@ -163,6 +169,8 @@ def encode_chunk(items: List[Tuple[Transition, Optional[float]]]) -> bytes:
     cols["priority"] = np.array(
         [np.nan if p is None else float(p) for _, p in items],
         dtype=np.float32)
+    cols["priority_ok"] = np.array([p is not None for _, p in items],
+                                   dtype=np.bool_)
     if isinstance(items, tracing.TracedChunk):
         cols["trace_id"] = np.array([items.trace_id], dtype=np.uint64)
         cols["trace_born"] = np.array([items.born], dtype=np.float64)
@@ -173,14 +181,52 @@ def encode_chunk(items: List[Tuple[Transition, Optional[float]]]) -> bytes:
 
 def decode_chunk(payload: bytes
                  ) -> List[Tuple[Transition, Optional[float]]]:
-    with np.load(io.BytesIO(payload)) as z:
-        cols = {k: z[k] for k in z.files}
-    n = len(cols["priority"])
+    """Decode + schema-validate one EXP payload.
+
+    Raises ``ValueError`` on a WELL-FRAMED but malformed chunk — missing
+    columns, truncated/mismatched column lengths, non-numeric dtypes —
+    which the gateway answers with a counted reject + ack (the PEER is
+    malformed; retransmitting the same bytes can never help).  Bytes
+    ``np.load`` itself cannot parse raise ``ConnectionError`` instead —
+    wire-level corruption stays on the drop-connection path, where the
+    client's retransmit IS the cure (its copy is clean)."""
+    try:
+        with np.load(io.BytesIO(payload)) as z:
+            cols = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise ConnectionError(f"unparseable EXP payload: {e!r}")
+    missing = [f for f in _FIELDS + ("priority",) if f not in cols]
+    if missing:
+        raise ValueError(f"malformed chunk: missing columns {missing}")
+    pr = cols["priority"]
+    if pr.ndim != 1 or pr.dtype.kind != "f":
+        raise ValueError(
+            f"malformed chunk: priority must be a 1-D float column "
+            f"(got ndim={pr.ndim}, dtype={pr.dtype})")
+    n = len(pr)
+    for f in _FIELDS:
+        c = cols[f]
+        if c.ndim < 1 or len(c) != n:
+            raise ValueError(
+                f"malformed chunk: column {f} is "
+                f"{'scalar' if c.ndim < 1 else f'length {len(c)}'}, "
+                f"want length {n}")
+        if c.dtype.kind not in "fiub":
+            raise ValueError(
+                f"malformed chunk: column {f} dtype {c.dtype} "
+                f"is not numeric")
+    ok = cols.get("priority_ok")
+    if ok is not None and (ok.ndim != 1 or len(ok) != n):
+        raise ValueError("malformed chunk: priority_ok length mismatch")
     items: List[Tuple[Transition, Optional[float]]] = []
     for i in range(n):
         t = Transition(*(cols[f][i] for f in _FIELDS))
-        p = cols["priority"][i]
-        items.append((t, None if np.isnan(p) else float(p)))
+        p = pr[i]
+        if ok is not None:
+            valid = bool(ok[i])
+        else:  # sentinel-era peer: NaN meant None on the old wire
+            valid = not np.isnan(p)
+        items.append((t, float(p) if valid else None))
     if "trace_id" in cols:  # re-wrap: the trace continues past the wire
         return tracing.TracedChunk(items,
                                    trace_id=int(cols["trace_id"][0]),
@@ -244,6 +290,13 @@ class DcnGateway:
         self.chunks_in = 0
         self.status_served = 0
         self.fenced = 0  # stale predecessors evicted by higher incarnations
+        # health-sentinel ingest counters: schema-invalid EXP frames
+        # rejected (counted warning + ack, never a session teardown) and
+        # transitions quarantined per source slot — both surfaced by the
+        # T_STATUS verb so fleet_top shows WHICH actor is poisoning
+        self.frames_rejected = 0
+        self.quarantined: Dict[str, int] = {}
+        self._validators: Dict[str, Any] = {}
         # all state above must exist before the first connection lands
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="dcn-accept", daemon=True)
@@ -310,6 +363,8 @@ class DcnGateway:
             "connections": self.connections,
             "chunks_in": self.chunks_in,
             "fenced": self.fenced,
+            "frames_rejected": self.frames_rejected,
+            "quarantined": dict(self.quarantined),
         }
         if self._health is not None:
             try:
@@ -370,6 +425,32 @@ class DcnGateway:
         self._recorder.record("slot-claimed", slot=ind,
                               incarnation=incarnation)
         return None
+
+    def _quarantine(self, slot: Optional[int], items: list) -> list:
+        """The DCN leg of the ingest quarantine (utils/health.py):
+        validate a decoded chunk per-transition and divert offenders to
+        ``{log_dir}/quarantine/`` with a per-slot counter, so remote
+        experience gets exactly the same admission control as the local
+        spawn-queue path — and ``fleet_top`` can name the poisoning
+        actor.  Returns the clean remainder (possibly empty)."""
+        from pytorch_distributed_tpu.utils import health
+
+        if not items or not health.quarantine_active():
+            return items
+        src = f"slot{slot}" if slot is not None else "anon"
+        validator = self._validators.get(src)
+        if validator is None:
+            validator = self._validators[src] = health.ChunkValidator()
+        items, bad = validator.filter(items)
+        if bad:
+            with self._slots_lock:
+                self.quarantined[src] = (self.quarantined.get(src, 0)
+                                         + len(bad))
+            self._recorder.record("chunk-quarantined", slot=slot,
+                                  n=len(bad), reason=bad[0][2])
+            health.get_quarantine(f"gateway-{src}").put(
+                bad, trace_id=getattr(items, "trace_id", 0))
+        return items
 
     def _fresh_tick(self, slot: Optional[int], seq: Optional[int]) -> bool:
         """Dedup retransmitted T_TICKs: a tick whose T_CLOCK ack was lost
@@ -432,22 +513,45 @@ class DcnGateway:
                             items = decode_chunk(payload)
                         except ConnectionError:
                             raise
+                        except ValueError as e:
+                            # WELL-FRAMED but schema-invalid (missing/
+                            # truncated/wrong-dtype columns): a malformed
+                            # peer.  Dropping the connection would only
+                            # make it retransmit the same poison until
+                            # its retransmit cap kills it — count, warn,
+                            # ack, and drop the FRAME instead; the
+                            # session survives.
+                            self.frames_rejected += 1
+                            self._recorder.record("frame-rejected",
+                                                  slot=slot,
+                                                  error=str(e)[:200])
+                            if self.frames_rejected <= 3:
+                                print(f"[dcn] rejected malformed EXP "
+                                      f"frame from slot {slot}: {e}",
+                                      flush=True)
+                            _send_frame(conn, T_CLOCK,
+                                        self._clock_payload())
+                            continue
                         except Exception as e:
-                            # wire corruption / malformed peer: drop the
-                            # connection — never feed garbage into replay
+                            # byte-level corruption np.load itself chokes
+                            # on: drop the connection — the client's
+                            # retransmit carries a clean copy (the wire
+                            # failure model; never decode garbage)
                             raise ConnectionError(
                                 f"undecodable EXP frame: {e!r}")
                         if isinstance(items, tracing.TracedChunk):
                             # actor flush -> gateway receipt: the wire hop
                             self._tracer.record_hop("gateway", items.born,
                                                     items.trace_id)
-                        try:
-                            self.put_chunk(items)
-                        except ValueError:
-                            # memory queue already closed: the run is over;
-                            # answer with the stop-carrying clock instead of
-                            # dying with a traceback
-                            pass
+                        items = self._quarantine(slot, items)
+                        if items:
+                            try:
+                                self.put_chunk(items)
+                            except ValueError:
+                                # memory queue already closed: the run is
+                                # over; answer with the stop-carrying
+                                # clock instead of dying with a traceback
+                                pass
                         self.chunks_in += 1
                         _send_frame(conn, T_CLOCK, self._clock_payload())
                     elif ftype == T_GETP:
@@ -973,6 +1077,15 @@ class RemoteClock:
         self._pending = 0
         self._last_flush = time.monotonic()
         self.learner_step = _StepShim(client)
+        # hang-watchdog progress board (utils/supervision.ProgressBoard),
+        # attached by fleet._remote_actor_main so the actor-host
+        # supervisor can see this worker's liveness — same duck surface
+        # as GlobalClock.bump_progress
+        self.progress = None
+
+    def bump_progress(self, label: str) -> None:
+        if self.progress is not None:
+            self.progress.bump(label)
 
     @property
     def stop(self) -> threading.Event:

@@ -13,6 +13,14 @@ Usage:
     learner = ShardedLearner(step_fn, mesh)          # step_fn from ops.losses
     state = learner.place(state)                     # replicate onto mesh
     state, metrics, td = learner.step(state, batch)  # batch: host np arrays
+
+Health contract: the ``step_fn``s the factory hands over are wrapped by
+the in-jit finite guard (utils/health.finite_guard, on by default) — a
+non-finite step returns the INPUT state selected through unchanged,
+``metrics["learner/skipped"]`` = 1 and a zeroed ``td``.  The guard is a
+per-leaf in-graph select, so it composes transparently with everything
+here: donation (the select resolves before outputs), dp-sharded batches,
+tensor/expert/pipeline state shardings, and the ICI all-reduce.
 """
 
 from __future__ import annotations

@@ -131,6 +131,19 @@ class Topology:
         # set when a SIGTERM (preemption notice) ended the run rather
         # than the step budget — observable by callers/tests
         self.preempted = threading.Event()
+        # ---- hang watchdog (health sentinel): every supervised role
+        # publishes liveness-progress marks on a shared board riding the
+        # clock's spawn pickle; the monitor SIGKILLs workers whose marks
+        # go stale past hang_deadline (utils/supervision.ProgressBoard).
+        from pytorch_distributed_tpu.utils import health
+        from pytorch_distributed_tpu.utils.supervision import ProgressBoard
+
+        self.health = health.resolve(opt.health_params)
+        labels = ["learner", "evaluator-0"] + [
+            f"actor-{i}" for i in range(opt.num_actors)]
+        self.progress_board = ProgressBoard(labels)
+        self.clock.progress = self.progress_board
+        self.hang_kills = 0  # watchdog SIGKILLs (health plane counter)
 
     # -- worker table (reference main.py:58-106 spawn loops) ----------------
 
@@ -240,6 +253,7 @@ class Topology:
             # after _worker_specs wired the clients, before anyone acts
             self.inference_server.start()
         try:
+            self.progress_board.note_start("learner")
             run_learner = get_worker("learner", opt.agent_type)
             run_learner(opt, self.spec, 0, self.handles.learner_side,
                         self.param_store, self.clock, self.learner_stats)
@@ -285,6 +299,8 @@ class Topology:
             target=_child_main, args=(role, self.opt.agent_type, args),
             name=f"{role}-{ind}", daemon=True)
         p.start()
+        # restart the slot's watchdog grace window with the incarnation
+        self.progress_board.note_start(f"{role}-{ind}")
         self._workers.append(p)
         self._proc_meta.append((p, role, ind, args))
 
@@ -300,7 +316,7 @@ class Topology:
         supervisor via utils/supervision.RestartBudget."""
         from pytorch_distributed_tpu.utils import flight_recorder
         from pytorch_distributed_tpu.utils.supervision import (
-            RestartBudget, describe_exit,
+            EXIT_HUNG, RestartBudget, describe_exit,
         )
 
         recorder = flight_recorder.get_recorder("runtime")
@@ -353,6 +369,65 @@ class Topology:
                         f"({describe_exit(p.exitcode)}); run stopped")
                     self.clock.stop.set()
                     return
+            # ---- hang watchdog: an alive-but-stuck worker never
+            # produces an exit code, so liveness is read off the
+            # progress board instead.  Hung children are SIGKILLed
+            # (flight recorder dumped first — the kill erases nothing)
+            # and actors respawn through the SAME RestartBudget as a
+            # crash, classified EXIT_HUNG.  Opt-in: hang_deadline=0 (the
+            # default) disables the pass entirely.
+            hd = self.health.hang_deadline
+            if hd and hd > 0:
+                hung = set(self.progress_board.hung(
+                    hd, self.health.hang_grace))
+                for p, role, ind, args in list(self._proc_meta):
+                    label = f"{role}-{ind}"
+                    if label not in hung or p.exitcode is not None:
+                        continue
+                    self.hang_kills += 1
+                    recorder.record("worker-hung", role=role, slot=ind,
+                                    age=round(self.progress_board.age(
+                                        label), 1))
+                    flight_recorder.dump_all(
+                        f"{label} hung (> {hd:g}s without progress); "
+                        f"watchdog SIGKILL")
+                    p.kill()
+                    p.join(5.0)
+                    self._workers.remove(p)
+                    self._proc_meta.remove((p, role, ind, args))
+                    if role == "actor" \
+                            and budget.request_restart(ind) is not None:
+                        budget.note_birth(ind)
+                        print(f"[runtime] {label} "
+                              f"({describe_exit(EXIT_HUNG)}); restart "
+                              f"{budget.count(ind)}/{max_restarts}")
+                        recorder.record("worker-restarted", role=role,
+                                        slot=ind, exit=EXIT_HUNG,
+                                        restarts=budget.count(ind))
+                        self._spawn(role, ind, args)
+                    else:
+                        print(f"[runtime] {label} "
+                              f"({describe_exit(EXIT_HUNG)}); "
+                              f"stopping run")
+                        recorder.record("worker-fatal", role=role,
+                                        slot=ind, exit=EXIT_HUNG)
+                        self.clock.stop.set()
+                        return
+                if "learner" in hung:
+                    # the learner runs on THIS process's main thread: a
+                    # SIGKILL from here kills the whole host, which is
+                    # exactly right — a stuck learner stalls every loop
+                    # and only an outer orchestrator (--resume) can
+                    # bring the run back.  Dump first; exit EXIT_HUNG.
+                    recorder.record("learner-hung")
+                    flight_recorder.dump_all(
+                        f"learner hung (> {hd:g}s without progress); "
+                        f"failing host fast")
+                    print(f"[runtime] learner "
+                          f"({describe_exit(EXIT_HUNG)}); exiting for "
+                          f"the outer orchestrator", flush=True)
+                    self.clock.stop.set()
+                    os._exit(EXIT_HUNG)
             time.sleep(poll)
 
     def _join_all(self, timeout: float = 240.0) -> None:
@@ -362,6 +437,25 @@ class Topology:
         # teardown — waiting is the safe side
         t0 = time.monotonic()
         deadline = t0 + timeout
+        hd = self.health.hang_deadline
+        if hd and hd > 0:
+            # watchdog-enabled shutdown: a worker whose progress mark is
+            # already stale cannot drain anything — a hang that landed
+            # AFTER the monitor exited (stop set) would otherwise pin
+            # this join for the full timeout.  Poll-join and terminate
+            # hung stragglers as their marks go stale.
+            while time.monotonic() < deadline:
+                alive = [w for w in self._workers if w.is_alive()]
+                if not alive:
+                    break
+                hung = set(self.progress_board.hung(
+                    hd, self.health.hang_grace))
+                for w in alive:
+                    if isinstance(w, _CTX.Process) and w.name in hung:
+                        print(f"[runtime] {w.name} hung at shutdown; "
+                              f"terminating")
+                        w.terminate()
+                time.sleep(0.25)
         for w in self._workers:
             w.join(max(0.1, deadline - time.monotonic()))
         if time.monotonic() - t0 > 30.0:

@@ -54,6 +54,12 @@ PyTree = Any
 MANIFEST = "MANIFEST.json"
 MANIFEST_FORMAT = 1
 _EPOCH_PREFIX = "epoch_"
+# marker dropped into a COMMITTED epoch the health sentinel rolled past
+# (utils/health.py / agents/learner.py): the epoch's params are known or
+# suspected diverged, so resolve_epoch must never resume from it — while
+# its artifacts stay on disk, digest-intact, for post-mortems.  fsck
+# reports these as ``rolled-back`` (clean), not violations.
+ROLLED_BACK = "ROLLED_BACK.json"
 
 # frame indices fired per save_epoch call, in order — CKPT_FAULTS
 # schedules (e.g. ``kill@9``) target frame ``FRAMES_PER_SAVE * save_index
@@ -568,6 +574,36 @@ def save_epoch(model_name: str, state: Any = None, memory: Any = None,
     return ed
 
 
+def mark_rolled_back(path: str, to_epoch: Optional[int] = None,
+                     reason: str = "") -> None:
+    """Fence a committed epoch off from resume (health-sentinel
+    rollback): atomic marker write; idempotent."""
+    import time as _time
+
+    _write_json_atomic(os.path.join(path, ROLLED_BACK), {
+        "wall": _time.time(),
+        "rolled_back_to": to_epoch,
+        "reason": reason,
+    })
+
+
+def fence_epochs_after(model_name: str, after_epoch: int,
+                       reason: str = "") -> List[int]:
+    """Mark every COMMITTED epoch numbered above ``after_epoch`` as
+    rolled-back (idempotent) — the rollback path's fencing step, kept
+    here so the committed-vs-fenced invariant (manifest = committed,
+    ROLLED_BACK marker = never resumed from) lives next to the readers
+    that honor it.  Returns the epoch numbers newly fenced."""
+    fenced = []
+    for k, path in _list_epochs(ckpt_root(model_name)):
+        if k > after_epoch \
+                and os.path.exists(os.path.join(path, MANIFEST)) \
+                and not os.path.exists(os.path.join(path, ROLLED_BACK)):
+            mark_rolled_back(path, to_epoch=after_epoch, reason=reason)
+            fenced.append(k)
+    return fenced
+
+
 def verify_epoch(path: str) -> Tuple[str, List[str]]:
     """(status, violations) for one epoch dir.
 
@@ -575,12 +611,17 @@ def verify_epoch(path: str) -> Tuple[str, List[str]]:
       digest verifies, extras consistent — violations empty.
     - ``incomplete``: no manifest (a crash mid-save; expected debris,
       not a violation).
+    - ``rolled-back``: committed but fenced off by the health sentinel
+      (``ROLLED_BACK.json``) — its params are suspected diverged, so it
+      is never resumed from; clean, not a violation.
     - ``corrupt``: manifest present but lying — torn artifacts, digest
       mismatches, inconsistent counters.  Every lie is listed.
     """
     mp = os.path.join(path, MANIFEST)
     if not os.path.exists(mp):
         return "incomplete", []
+    if os.path.exists(os.path.join(path, ROLLED_BACK)):
+        return "rolled-back", []
     bad: List[str] = []
     try:
         with open(mp) as f:
@@ -624,14 +665,21 @@ def verify_epoch(path: str) -> Tuple[str, List[str]]:
     return ("complete" if not bad else "corrupt"), bad
 
 
-def resolve_epoch(model_name: str) -> Optional[EpochInfo]:
+def resolve_epoch(model_name: str,
+                  before: Optional[int] = None) -> Optional[EpochInfo]:
     """Newest COMPLETE epoch under ``{model_name}_ckpt``, or None.
 
     Torn (uncommitted) and digest-mismatched epochs are skipped with a
     note — a crash mid-save or a partially synced copy must cost at most
-    one epoch of progress, never the run."""
+    one epoch of progress, never the run.  Epochs fenced off by a
+    health-sentinel rollback (``ROLLED_BACK.json``) are skipped the same
+    way.  ``before`` restricts the search to epochs numbered strictly
+    below it — the progressive-rollback ladder (each successive rollback
+    targets an older restore point than the last)."""
     root = ckpt_root(model_name)
     for k, path in _list_epochs(root):
+        if before is not None and k >= before:
+            continue
         status, bad = verify_epoch(path)
         if status == "complete":
             with open(os.path.join(path, MANIFEST)) as f:
@@ -685,18 +733,35 @@ def gc_epochs(root: str, retain: int = 3,
               in_progress: Optional[int] = None) -> List[str]:
     """Delete committed epochs beyond the newest ``retain`` plus any
     uncommitted debris (except ``in_progress``, the epoch a caller is
-    mid-writing).  Returns the paths removed."""
+    mid-writing).  Returns the paths removed.
+
+    Rollback-fenced epochs (``ROLLED_BACK.json``) never count against
+    the retention budget — they are unusable for resume, so letting
+    them crowd out the newest GOOD epochs would destroy the run's only
+    recovery points.  They are kept (as post-mortem evidence) while
+    newer than the oldest retained good epoch, collected once older."""
     removed = []
     committed = []
+    rolled = []
     for k, path in _list_epochs(root):
         if os.path.exists(os.path.join(path, MANIFEST)):
-            committed.append((k, path))
+            if os.path.exists(os.path.join(path, ROLLED_BACK)):
+                rolled.append((k, path))
+            else:
+                committed.append((k, path))
         elif k != in_progress:
             shutil.rmtree(path, ignore_errors=True)
             removed.append(path)
+    kept = committed[:max(retain, 1)]
     for k, path in committed[max(retain, 1):]:
         shutil.rmtree(path, ignore_errors=True)
         removed.append(path)
+    if kept:
+        floor = kept[-1][0]  # oldest retained good epoch
+        for k, path in rolled:
+            if k < floor:
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
     return removed
 
 
@@ -706,18 +771,36 @@ def fsck(root: str) -> dict:
     COMMITTED epoch is lying about its contents — incomplete epochs are
     expected crash debris and only reported."""
     report: dict = {"root": root, "epochs": [], "violations": [],
-                    "newest_complete": None}
+                    "newest_complete": None, "rolled_back": 0}
     if not os.path.isdir(root):
         report["violations"].append(f"{root}: no such directory")
         return report
+    complete_steps: List[Tuple[int, int]] = []  # (epoch, learner_step)
     for k, path in _list_epochs(root):
         status, bad = verify_epoch(path)
         entry = {"epoch": k, "status": status, "violations": bad}
-        if status == "complete":
+        if status in ("complete", "rolled-back"):
             with open(os.path.join(path, MANIFEST)) as f:
                 entry["learner_step"] = json.load(f).get("learner_step")
+        if status == "complete":
             if report["newest_complete"] is None:
                 report["newest_complete"] = k
+            if entry["learner_step"] is not None:
+                complete_steps.append((k, int(entry["learner_step"])))
+        elif status == "rolled-back":
+            report["rolled_back"] += 1
         report["epochs"].append(entry)
         report["violations"].extend(bad)
+    # learner_step must grow with the epoch number across RESUMABLE
+    # epochs.  A regression means two epochs disagree about time — on a
+    # healthy run that cannot happen, and on a run that rolled back the
+    # overtaken epochs carry ROLLED_BACK markers (status above) and are
+    # excluded here, so a rolled-back-mid-training root still exits
+    # clean.  A regression among unmarked complete epochs is a real lie.
+    for (k_new, s_new), (k_old, s_old) in zip(complete_steps,
+                                              complete_steps[1:]):
+        if s_new < s_old:
+            report["violations"].append(
+                f"{root}: epoch {k_new} learner_step {s_new} regressed "
+                f"below epoch {k_old}'s {s_old} (an unmarked rollback?)")
     return report

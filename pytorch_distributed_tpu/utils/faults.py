@@ -36,9 +36,28 @@ Actions (``action@frame`` or ``action@frame:arg``):
   (utils/checkpoint.py save_epoch write points, ``CKPT_FAULTS`` env)
   use it to die MID-write and prove the epoch commit protocol.
 
+Health-sentinel verbs (tests/test_health.py drills the ladder):
+
+- ``poison_chunk@N``   — data-plane: the actor-side feeder
+  (memory/feeder.py, ``FEEDER_FAULTS``, one frame per flush) poisons
+  flush N's chunk — NaN rewards, garbage priority, NaN obs when the
+  state dtype is float — which the ingest quarantine must catch.
+- ``poison_grad@N``    — data-plane: the learner (agents/learner.py,
+  ``LEARNER_FAULTS``, one frame per update step) injects a non-finite
+  loss into update N by NaN-ing the sampled batch's rewards — the
+  in-jit finite guard must skip the step with params unchanged.
+- ``hang@N[:S]``       — the worker stops progressing WITHOUT exiting
+  (infinite sleep, or S seconds when given): no exception, no exit
+  code — the hang watchdog (utils/supervision.ProgressBoard) must
+  detect, SIGKILL and respawn it.  Plane-agnostic: schedule it on any
+  instrumented endpoint (``ACTOR_FAULTS`` counts actor vector ticks).
+
 Injectors are wired through env vars so fault schedules reach spawn
 children without plumbing: ``DCN_FAULTS_CLIENT`` / ``DCN_FAULTS_GATEWAY``
-/ ``CKPT_FAULTS`` hold either a scripted spec or ``random:SEED`` (see
+(wire roles) and ``{ROLE}_FAULTS`` for the other planes — ``CKPT_FAULTS``
+(checkpoint writer), ``FEEDER_FAULTS`` (actor-side chunk flushes),
+``LEARNER_FAULTS`` (update steps), ``ACTOR_FAULTS`` (vector ticks) —
+hold either a scripted spec or ``random:SEED`` (see
 ``FaultInjector.from_env``); fleet.py exposes the DCN pair as
 ``--faults-client`` / ``--faults-gateway`` CLI knobs.  No spec = a null
 injector whose per-frame cost is one lock + dict probe.
@@ -53,7 +72,16 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 FaultEvent = Tuple[int, str, float]  # (frame index, action, arg)
 
-_ACTIONS = ("sever", "delay", "blackhole", "corrupt", "crash", "kill")
+# ``poison_chunk`` / ``poison_grad`` are DATA-plane verbs: the injector
+# cannot mutate structured data itself, so the instrumented boundary
+# (memory/feeder.py QueueFeeder.flush, agents/learner.py) asks for them
+# via ``data_frame(want=...)`` and applies the poison — NaN obs/reward /
+# garbage priority at the feeder, a non-finite loss injected into the
+# update at the learner.  ``hang`` makes the worker stop progressing
+# WITHOUT exiting (an infinite sleep after a flight-recorder dump) — the
+# alive-but-stuck failure mode the hang watchdog exists to catch.
+_ACTIONS = ("sever", "delay", "blackhole", "corrupt", "crash", "kill",
+            "poison_chunk", "poison_grad", "hang")
 
 # default per-frame probabilities for the random mode — light enough that
 # a healthy session layer rides through, frequent enough that a soak of a
@@ -186,16 +214,41 @@ class FaultInjector:
 
     def frame(self, payload: bytes = b"") -> bytes:
         """Account one frame operation; fire its scheduled events."""
+        payload, _ = self._step(payload, ())
+        return payload
+
+    def data_frame(self, want: Tuple[str, ...] = ()
+                   ) -> List[Tuple[str, float]]:
+        """Account one DATA-plane operation (a feeder flush, a learner
+        step): fires the side-effectful events exactly like ``frame``
+        and returns the fired ``want`` events — the poison verbs the
+        caller must apply itself (it owns the structured data the
+        injector cannot mutate)."""
+        _, hits = self._step(b"", tuple(want))
+        return hits
+
+    def _step(self, payload: bytes, want: Tuple[str, ...]
+              ) -> Tuple[bytes, List[Tuple[str, float]]]:
         with self._lock:
             n = self._n
             self._n += 1
             events = self._by_frame.get(n)
+        hits: List[Tuple[str, float]] = []
         if not events:
-            return payload
+            return payload, hits
         for action, arg in events:
+            if action.startswith("poison") and action not in want:
+                # a data-plane verb scheduled on a wire plane (or a
+                # plane that doesn't ask for it) is inert by design —
+                # record it so a mis-wired drill is diagnosable
+                self._note(action, n, fatal=False)
+                continue
             self.injected += 1
-            self._note(action, n, fatal=action in ("crash", "kill"))
-            if action == "delay":
+            self._note(action, n,
+                       fatal=action in ("crash", "kill", "hang"))
+            if action in want:
+                hits.append((action, arg))
+            elif action == "delay":
                 time.sleep(arg)
             elif action == "sever":
                 raise InjectedDisconnect(
@@ -215,14 +268,33 @@ class FaultInjector:
                 print(f"[faults:{self.name}] SIGKILL at frame {n}",
                       flush=True)
                 os.kill(os.getpid(), signal.SIGKILL)
+            elif action == "hang":
+                # stop progressing WITHOUT exiting: no exception, no
+                # exit code — exactly the failure the watchdog must
+                # catch.  The blackbox dump already happened (_note
+                # fatal), because nothing runs after the SIGKILL that
+                # ends this.  ``arg`` (seconds) bounds the hang for
+                # self-recovering drills; 0 = forever.
+                print(f"[faults:{self.name}] HANG at frame {n}",
+                      flush=True)
+                deadline = (time.monotonic() + arg) if arg > 0 \
+                    else float("inf")
+                while time.monotonic() < deadline:
+                    time.sleep(0.2)
             elif action == "corrupt":
                 if payload:
                     mutated = bytearray(payload)
-                    mutated[len(mutated) // 2] ^= 0xFF
+                    # flip the leading magic AND a middle byte: a flip
+                    # only in the middle can land in zip member padding
+                    # (savez 64-byte aligns members) and decode clean —
+                    # the drill must corrupt DETERMINISTICALLY for any
+                    # payload layout, so the format magic always breaks
+                    for i in {0, len(mutated) // 2}:
+                        mutated[i] ^= 0xFF
                     payload = bytes(mutated)
                 else:
                     payload = b"\xff"  # give empty frames something to break
-        return payload
+        return payload, hits
 
     @property
     def frames_seen(self) -> int:

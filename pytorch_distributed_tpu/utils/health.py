@@ -1,0 +1,491 @@
+"""Training health sentinel: numeric guards, anomaly detection, quarantine.
+
+The fleet survives *process* faults — DCN reconnects, SIGKILL with
+crash-consistent epochs, post-mortem blackboxes — but until this module
+nothing protected the *training computation*: one NaN gradient reached
+Adam and every parameter was garbage forever, one poisoned experience
+chunk sat in replay getting re-sampled, and an alive-but-stuck worker
+stalled the run with exit code 0 never arriving.  This module is the
+detection half of the detection → containment → recovery ladder:
+
+- **in-jit numeric guards** (``finite_guard``): wraps any learner train
+  step ``(TrainState, batch) -> (TrainState, metrics, td)`` so a step
+  whose loss/grad-norm/TD comes out non-finite is *skipped* — params,
+  opt-state and the step counter pass through unchanged via an in-graph
+  select, and the returned metrics carry ``learner/skipped`` so the PER
+  write-back paths (memory/device_per.py, memory/device_sequence.py, the
+  host path in agents/learner.py) suppress the priority scatter for that
+  step.  The guard is pure XLA — no host syncs, no extra dispatches —
+  and costs a handful of selects (<2% of a learner step; bench.py
+  ``health_overhead`` proves it on whatever chip runs the bench).
+- **host-side anomaly detection** (``AnomalyDetector``): rolling EWMA
+  z-score on the loss, grad-norm spike ratio, |TD| explosion,
+  priority-mass collapse and the skipped-step counter, evaluated on the
+  learner's stats cadence.  Past ``anomaly_threshold`` consecutive
+  anomalous windows the learner triggers an automatic rollback to the
+  last good checkpoint epoch (agents/learner.py; bounded by
+  ``max_rollbacks`` before the run fails fast).
+- **ingest quarantine** (``ChunkValidator`` + ``QuarantineStore``):
+  transitions are validated at the single-owner ingest boundaries —
+  the learner-side queue drains (memory/feeder.py QueueOwner,
+  memory/device_replay.py DeviceReplayIngest) and the DCN gateway
+  (parallel/dcn.py) — and offenders are written to
+  ``{log_dir}/quarantine/<source>-<n>.npz`` with their trace id instead
+  of entering replay.  Per-source counters feed the T_STATUS health
+  plane so ``fleet_top`` shows which actor is poisoning.
+
+Knobs live in ``config.HealthParams``; every field is env-overridable as
+``TPU_APEX_HEALTH_<FIELD>`` (the same spawn-inheritance trick the fault
+planes use), so drills and fleet launchers can flip them without
+plumbing.  ``TPU_APEX_QUARANTINE=0`` kills the ingest-validation plane
+entirely (chunks flow unchecked, the pre-sentinel behaviour).
+
+The hang-watchdog half of the sentinel lives in utils/supervision.py
+(``ProgressBoard``) and the supervisors (runtime.py, fleet.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# metrics key every consumer of the guard keys on: 1.0 for a skipped
+# (non-finite) substep, 0.0 otherwise; summed — not last-sampled — over
+# fused multi-step dispatches (reduce_scan_metrics)
+SKIPPED_KEY = "learner/skipped"
+
+_ENV_PREFIX = "TPU_APEX_HEALTH_"
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "off", "no", "")
+
+
+def resolve(hp) -> Any:
+    """Apply ``TPU_APEX_HEALTH_<FIELD>`` env overrides to a HealthParams
+    (config.py) — same override-by-env contract as the fault planes, so
+    a drill can flip sentinel knobs on spawn children without threading
+    them through every constructor.  Returns a NEW instance; the input
+    is never mutated (Options rides spawn pickles)."""
+    changes = {}
+    for f in dataclasses.fields(hp):
+        raw = os.environ.get(_ENV_PREFIX + f.name.upper())
+        if raw is None:
+            continue
+        if f.type in ("bool", bool) or isinstance(getattr(hp, f.name), bool):
+            changes[f.name] = raw.strip().lower() not in (
+                "0", "false", "off", "no", "")
+        elif isinstance(getattr(hp, f.name), int):
+            changes[f.name] = int(float(raw))
+        else:
+            changes[f.name] = float(raw)
+    return dataclasses.replace(hp, **changes) if changes else hp
+
+
+def quarantine_active() -> bool:
+    """Is the ingest-validation plane on in this process?  Default on —
+    the per-transition cost is a few scalar finiteness checks (image
+    states are uint8 and skip the array scan entirely)."""
+    return _env_flag("TPU_APEX_QUARANTINE", True)
+
+
+# ---------------------------------------------------------------------------
+# in-jit numeric guards
+# ---------------------------------------------------------------------------
+
+def finite_guard(step_fn):
+    """Wrap a ``(TrainState, batch) -> (TrainState, metrics, td)`` train
+    step with an in-graph finite check: when any metric scalar (loss,
+    grad norm, ...) or the TD/priority output is non-finite, the ENTIRE
+    candidate state is discarded and the input state passes through
+    unchanged (``jnp.where`` select per leaf — donation-safe, no host
+    round trip), so one bad batch never reaches Adam, the target net, or
+    the step counter.  ``metrics[SKIPPED_KEY]`` reports the skip; the
+    raw (possibly non-finite) loss stays in the metrics so the host-side
+    anomaly detector sees what actually happened.  TD output is zeroed
+    on a skip so a write-back path that ignores the flag still cannot
+    scatter NaN priorities."""
+    import jax
+    import jax.numpy as jnp
+
+    def guarded(state, batch):
+        new_state, metrics, td = step_fn(state, batch)
+        ok = jnp.all(jnp.isfinite(td))
+        for v in metrics.values():
+            ok = ok & jnp.all(jnp.isfinite(v))
+        sel = lambda n, o: jax.tree_util.tree_map(
+            lambda a, b: jnp.where(ok, a, b), n, o)
+        out_state = sel(new_state, state)
+        out_td = jnp.where(ok, td, jnp.zeros_like(td))
+        metrics = dict(metrics)
+        metrics[SKIPPED_KEY] = 1.0 - ok.astype(jnp.float32)
+        return out_state, metrics, out_td
+
+    return guarded
+
+
+def reduce_scan_metrics(metrics):
+    """Collapse a scanned fused dispatch's stacked substep metrics to one
+    row: the last substep's value per key — the sampling contract the
+    learner's stats cadence already has — EXCEPT counter-like keys
+    (``learner/skipped``), which sum over the scan so a dispatch reports
+    how many of its K substeps were skipped, not just whether the last
+    one was."""
+    import jax
+    import jax.numpy as jnp
+
+    if not isinstance(metrics, dict):
+        return jax.tree_util.tree_map(lambda x: x[-1], metrics)
+    return {k: (jnp.sum(v, axis=0) if k == SKIPPED_KEY else v[-1])
+            for k, v in metrics.items()}
+
+
+def suppress_writeback(ok_flag, updated_replay, prior_replay):
+    """Select between a priority-updated replay state and the untouched
+    one on the guard's skip flag — the fused PER planes call this so a
+    skipped step's (zeroed) TD never overwrites real priorities."""
+    import jax
+    import jax.numpy as jnp
+
+    ok = ok_flag < 0.5  # SKIPPED_KEY semantics: 1.0 == skipped
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), updated_replay, prior_replay)
+
+
+# ---------------------------------------------------------------------------
+# host-side rolling anomaly detection
+# ---------------------------------------------------------------------------
+
+class _Ewma:
+    """Exponentially weighted mean/std with a warmup count."""
+
+    def __init__(self, decay: float = 0.97):
+        self.decay = decay
+        self.n = 0
+        self.mean = 0.0
+        self.var = 0.0
+
+    def update(self, x: float) -> None:
+        self.n += 1
+        if self.n == 1:
+            self.mean = x
+            return
+        d = x - self.mean
+        self.mean += (1.0 - self.decay) * d
+        self.var = self.decay * (self.var + (1.0 - self.decay) * d * d)
+
+    @property
+    def std(self) -> float:
+        return math.sqrt(max(self.var, 0.0))
+
+
+class AnomalyDetector:
+    """Rolling divergence detector fed on the learner's stats cadence.
+
+    ``observe(...)`` returns the list of anomaly labels this window
+    tripped (empty = healthy) and maintains the consecutive-anomalous-
+    window streak; ``should_rollback()`` is true once the streak reaches
+    ``threshold``.  Signals:
+
+    - ``nonfinite``        — loss or grad norm is NaN/inf (a guard skip
+      that still surfaced, or a guardless run diverging);
+    - ``skipped``          — the in-jit guard skipped >= 1 step in the
+      window;
+    - ``loss_spike``       — loss z-score against its own EWMA above
+      ``zmax`` (warmup: the first ``warmup`` windows never trip);
+    - ``grad_spike``       — grad norm above ``grad_spike`` x its EWMA;
+    - ``td_explosion``     — mean |TD| above ``grad_spike`` x its EWMA;
+    - ``priority_collapse``— total PER priority mass fell to ~0 while
+      the buffer holds rows (every sample draws the same handful).
+    """
+
+    WARMUP = 8
+
+    def __init__(self, zmax: float = 8.0, grad_spike: float = 100.0,
+                 threshold: int = 3):
+        self.zmax = zmax
+        self.grad_spike = grad_spike
+        self.threshold = max(1, int(threshold))
+        self.loss = _Ewma()
+        self.grad = _Ewma()
+        self.td = _Ewma()
+        self.streak = 0
+        self.windows = 0
+        self.anomalies_total = 0
+
+    def observe(self, loss: Optional[float] = None,
+                grad_norm: Optional[float] = None,
+                td_mean: Optional[float] = None,
+                priority_mass: Optional[float] = None,
+                replay_rows: int = 0,
+                skipped: float = 0.0) -> List[str]:
+        self.windows += 1
+        out: List[str] = []
+        if skipped and skipped > 0:
+            out.append("skipped")
+        for val, ewma, spike_label in ((loss, self.loss, "loss_spike"),
+                                       (grad_norm, self.grad, "grad_spike"),
+                                       (td_mean, self.td, "td_explosion")):
+            if val is None:
+                continue
+            if not math.isfinite(val):
+                if "nonfinite" not in out:
+                    out.append("nonfinite")
+                continue  # never fold infinities into the EWMA
+            warm = ewma.n >= self.WARMUP
+            if warm and spike_label == "loss_spike":
+                z = abs(val - ewma.mean) / max(ewma.std, 1e-12)
+                if z > self.zmax:
+                    out.append(spike_label)
+            elif warm and abs(val) > self.grad_spike * max(
+                    abs(ewma.mean), 1e-12):
+                out.append(spike_label)
+            if spike_label not in out:
+                # anomalous readings stay OUT of the baseline: a spike
+                # that shifted its own EWMA would mask the next one
+                ewma.update(val)
+        if (priority_mass is not None and replay_rows > 0
+                and priority_mass <= 1e-12):
+            out.append("priority_collapse")
+        self.streak = self.streak + 1 if out else 0
+        self.anomalies_total += len(out)
+        return out
+
+    def should_rollback(self) -> bool:
+        return self.streak >= self.threshold
+
+    def reset(self) -> None:
+        """Post-rollback: restart the streak AND the baselines — the
+        restored epoch's loss scale may legitimately differ from the
+        diverged tail's."""
+        self.loss = _Ewma()
+        self.grad = _Ewma()
+        self.td = _Ewma()
+        self.streak = 0
+
+
+# ---------------------------------------------------------------------------
+# ingest validation + quarantine
+# ---------------------------------------------------------------------------
+
+def poison_items(items):
+    """Deterministically poison a ``[(Transition, priority), ...]`` chunk
+    — the ``poison_chunk`` fault verb's payload (utils/faults.py):
+    rewards go NaN, priorities go NaN (the garbage a diverged actor
+    would compute), and float observations go NaN too (uint8 frames
+    cannot hold NaN, so image chunks poison through the scalars).
+    Preserves a TracedChunk wrapper so the quarantine file keeps the
+    trace id."""
+    out = []
+    for t, _p in items:
+        repl = {"reward": np.asarray(t.reward).dtype.type(np.nan)}
+        s0 = np.asarray(t.state0)
+        if s0.dtype.kind == "f":
+            repl["state0"] = np.full_like(s0, np.nan)
+        out.append((t._replace(**repl), float("nan")))
+    from pytorch_distributed_tpu.utils import tracing
+
+    if isinstance(items, tracing.TracedChunk):
+        return tracing.TracedChunk(out, trace_id=items.trace_id,
+                                   born=items.born)
+    return out
+
+def _finite_scalar(x) -> bool:
+    try:
+        return bool(np.isfinite(x))
+    except TypeError:
+        return False
+
+
+class ChunkValidator:
+    """Per-ingest-boundary transition validator.
+
+    Checks, per ``(Transition, priority)`` item: non-finite
+    obs/reward/gamma/terminal (float state arrays scanned; integer
+    states — the uint8 Atari rows — cannot hold NaN and skip the array
+    scan), non-finite or negative priority, non-finite float actions,
+    discrete actions outside ``[0, num_actions)``, and shape/dtype
+    drift against the expected schema.  The schema comes from the
+    owning memory when it declares one (``state_shape``/``state_dtype``)
+    and is otherwise latched from the first item seen — drift mid-run
+    is what poisons a fixed-schema ring."""
+
+    def __init__(self, state_shape: Optional[Tuple[int, ...]] = None,
+                 state_dtype=None, num_actions: Optional[int] = None):
+        self.state_shape = tuple(state_shape) if state_shape else None
+        self.state_dtype = np.dtype(state_dtype) if state_dtype else None
+        self.num_actions = num_actions
+        self.checked = 0
+        self.rejected = 0
+
+    @classmethod
+    def for_memory(cls, memory) -> "ChunkValidator":
+        return cls(state_shape=getattr(memory, "state_shape", None),
+                   state_dtype=getattr(memory, "state_dtype", None))
+
+    def _check(self, t, priority) -> Optional[str]:
+        if priority is not None and (
+                not _finite_scalar(priority) or float(priority) < 0.0):
+            return f"invalid priority {priority!r}"
+        for name in ("reward", "gamma_n", "terminal1"):
+            if not _finite_scalar(getattr(t, name)):
+                return f"non-finite {name}"
+        for name in ("state0", "state1"):
+            arr = np.asarray(getattr(t, name))
+            if self.state_shape is None:
+                self.state_shape = arr.shape
+            elif arr.shape != self.state_shape:
+                return (f"{name} shape {arr.shape} != "
+                        f"expected {self.state_shape}")
+            if self.state_dtype is None:
+                self.state_dtype = arr.dtype
+            elif arr.dtype != self.state_dtype:
+                return (f"{name} dtype {arr.dtype} != "
+                        f"expected {self.state_dtype}")
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                return f"non-finite {name}"
+        a = np.asarray(t.action)
+        if a.dtype.kind == "f" and not np.isfinite(a).all():
+            return "non-finite action"
+        if (self.num_actions is not None and a.dtype.kind in "iu"
+                and a.size and not ((a >= 0) & (a < self.num_actions)).all()):
+            return f"action out of range [0, {self.num_actions})"
+        return None
+
+    def filter(self, items) -> Tuple[list, List[Tuple[Any, Optional[float],
+                                                      str]]]:
+        """Split ``[(Transition, priority), ...]`` into (clean items,
+        rejected ``(transition, priority, reason)`` triples).  The clean
+        list preserves the input's TracedChunk identity when nothing was
+        rejected (the common case costs no copy of the wrapper)."""
+        self.checked += len(items)
+        bad: List[Tuple[Any, Optional[float], str]] = []
+        good: list = []
+        for t, p in items:
+            reason = self._check(t, p)
+            if reason is None:
+                good.append((t, p))
+            else:
+                bad.append((t, p, reason))
+        self.rejected += len(bad)
+        if not bad:
+            return items, bad
+        from pytorch_distributed_tpu.utils import tracing
+
+        if isinstance(items, tracing.TracedChunk):
+            good = tracing.TracedChunk(good, trace_id=items.trace_id,
+                                       born=items.born)
+        return good, bad
+
+
+class QuarantineStore:
+    """One ingest source's quarantine sink: rejected transitions land in
+    ``{log_dir}/quarantine/<source>-<n>.npz`` (columns best-effort
+    stacked, plus ``reason``/``trace_id`` columns) instead of replay.
+    The directory rides the same per-process configuration as the
+    flight recorder (``flight_recorder.configure`` / the
+    ``TPU_APEX_BLACKBOX_DIR`` spawn-inheritance env), so no new
+    plumbing reaches the workers.  Bounded: past ``max_files`` writes
+    the store only counts — a poisoning actor must not fill the disk
+    before the supervisor reacts."""
+
+    def __init__(self, source: str, max_files: int = 64):
+        self.source = source
+        self.max_files = max_files
+        self.count = 0       # transitions quarantined (lifetime)
+        self.files = 0       # files actually written
+        self.last_path: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def _dir(self) -> Optional[str]:
+        from pytorch_distributed_tpu.utils import flight_recorder
+
+        base = flight_recorder._dump_dir()
+        return os.path.join(base, "quarantine") if base else None
+
+    def put(self, rejected, trace_id: int = 0) -> Optional[str]:
+        """Record ``[(transition, priority, reason), ...]``; returns the
+        written path (None when no log dir is configured or the file
+        budget is spent — counting continues either way)."""
+        if not rejected:
+            return None
+        with self._lock:
+            self.count += len(rejected)
+            n = self.files
+            if n >= self.max_files:
+                return None
+            self.files += 1
+        target = self._dir()
+        if not target:
+            return None
+        from pytorch_distributed_tpu.utils.experience import Transition
+        from pytorch_distributed_tpu.utils.tracing import format_trace_id
+
+        cols: Dict[str, np.ndarray] = {}
+        for f in Transition._fields:
+            vals = [np.asarray(getattr(t, f)) for t, _p, _r in rejected]
+            try:
+                cols[f] = np.stack(vals)
+            except ValueError:  # shape-drifted offenders can't stack
+                cols[f] = np.array([str(v.shape) + ":" + str(v.dtype)
+                                    for v in vals])
+        cols["priority"] = np.array(
+            [np.nan if p is None else float(p) for _t, p, _r in rejected],
+            dtype=np.float64)
+        cols["reason"] = np.array([r for _t, _p, r in rejected])
+        cols["trace_id"] = np.array([format_trace_id(trace_id)])
+        safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
+                       for c in self.source) or "source"
+        path = os.path.join(target, f"{safe}-{n:05d}.npz")
+        try:
+            os.makedirs(target, exist_ok=True)
+            tmp = path + ".tmp.npz"
+            np.savez(tmp, **cols)
+            os.replace(tmp, path)  # readers never see a torn file
+        except OSError:
+            return None  # quarantine is best-effort; counting is not
+        self.last_path = path
+        if n == 0:  # first offender per source is loud; the rest are
+            # counters on the health plane (a poisoning actor would
+            # otherwise flood the log at chunk rate)
+            print(f"[health] quarantined {len(rejected)} transition(s) "
+                  f"from {self.source} ({rejected[0][2]}) -> {path}",
+                  flush=True)
+        return path
+
+
+# per-process registry, mirroring flight_recorder's: one store per
+# source, aggregated counters for the T_STATUS health plane
+_q_lock = threading.Lock()
+_q_stores: Dict[str, QuarantineStore] = {}
+
+
+def get_quarantine(source: str, max_files: int = 64) -> QuarantineStore:
+    with _q_lock:
+        st = _q_stores.get(source)
+        if st is None:
+            st = _q_stores[source] = QuarantineStore(source,
+                                                     max_files=max_files)
+        return st
+
+
+def quarantine_counts() -> Dict[str, int]:
+    """{source: transitions quarantined} across this process — the
+    health plane's read (fleet.py _health_snapshot -> T_STATUS ->
+    fleet_top)."""
+    with _q_lock:
+        return {s: st.count for s, st in _q_stores.items() if st.count}
+
+
+def reset() -> None:
+    """Test isolation: drop all quarantine stores."""
+    with _q_lock:
+        _q_stores.clear()

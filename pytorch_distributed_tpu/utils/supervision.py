@@ -14,16 +14,23 @@ slot's budget, so only genuine crash loops exhaust it.
 
 from __future__ import annotations
 
+import multiprocessing as mp
 import time
-from typing import Dict, Optional
+from typing import Dict, Iterable, List, Optional
+
+_CTX = mp.get_context("spawn")
 
 # Worker exit-code vocabulary shared by the supervisors.  Distinguishing
 # "crashed" from "lost its session" matters in logs: a fleet of actors
 # all exiting EXIT_DISCONNECTED points at the learner host / network, not
 # at the actor code (fleet.py maps DcnClient.disconnected to this code).
+# EXIT_HUNG marks a worker the hang watchdog SIGKILLed for making no
+# progress within its deadline (alive-but-stuck — the failure mode that
+# never produces an exit code on its own).
 EXIT_OK = 0
 EXIT_CRASH = 1
 EXIT_DISCONNECTED = 3
+EXIT_HUNG = 4
 
 
 def describe_exit(code: Optional[int]) -> str:
@@ -32,9 +39,85 @@ def describe_exit(code: Optional[int]) -> str:
         return "exit 0 (run complete)"
     if code == EXIT_DISCONNECTED:
         return f"exit {code} (DCN session lost)"
+    if code == EXIT_HUNG:
+        return f"exit {code} (hung; watchdog killed)"
     if code is not None and code < 0:
         return f"signal {-code}"
     return f"exit {code} (crash)"
+
+
+class ProgressBoard:
+    """Per-worker liveness-progress marks for the hang watchdog.
+
+    A crash produces an exit code; a *hang* produces nothing — the
+    reference (and this repo before the health sentinel) would wait on a
+    stuck worker forever.  Every supervised role owns a progress counter
+    already (actor ticks, learner steps, eval episodes); this board
+    makes those counters *observable across processes*: one
+    ``mp.Value`` pair per slot label (wall-clock of the last mark + a
+    mark count), created by the supervisor BEFORE spawn so the shared
+    values ride the worker args' pickle.  ``bump`` is the worker-side
+    hot call: two lock-free Value stores.
+
+    ``hung(deadline, grace, now)`` returns the labels whose last mark is
+    older than ``deadline`` seconds — except workers that have never
+    marked, which get ``deadline + grace`` from their start stamp (the
+    compile-grace window: a first jit can legitimately take minutes).
+    Supervisors SIGKILL hung workers (flight-recorder dump first) and
+    respawn through the normal RestartBudget with EXIT_HUNG.
+    """
+
+    def __init__(self, labels: Iterable[str]):
+        self._last = {lb: _CTX.Value("d", 0.0, lock=False) for lb in labels}
+        self._count = {lb: _CTX.Value("l", 0, lock=False) for lb in labels}
+
+    @property
+    def labels(self) -> List[str]:
+        return list(self._last)
+
+    def note_start(self, label: str) -> None:
+        """Stamp a (re)spawn: the grace window restarts from here."""
+        if label in self._last:
+            self._last[label].value = time.time()
+            self._count[label].value = 0
+
+    def bump(self, label: str, n: int = 1) -> None:
+        v = self._last.get(label)
+        if v is None:
+            return
+        v.value = time.time()
+        self._count[label].value += n
+
+    def marks(self, label: str) -> int:
+        c = self._count.get(label)
+        return int(c.value) if c is not None else 0
+
+    def age(self, label: str, now: Optional[float] = None) -> float:
+        """Seconds since the label's last mark (inf before note_start)."""
+        v = self._last.get(label)
+        if v is None or v.value == 0.0:
+            return float("inf")
+        return (time.time() if now is None else now) - v.value
+
+    def hung(self, deadline: float, grace: float = 0.0,
+             now: Optional[float] = None,
+             only: Optional[Iterable[str]] = None) -> List[str]:
+        """Labels with no progress inside their deadline.  Workers that
+        have never bumped (still compiling / importing) answer to
+        ``deadline + grace`` instead; workers never started (no
+        note_start) are skipped — the supervisor hasn't spawned them."""
+        if deadline <= 0:
+            return []
+        now = time.time() if now is None else now
+        out = []
+        for lb in (self._last if only is None else only):
+            v = self._last.get(lb)
+            if v is None or v.value == 0.0:
+                continue
+            limit = deadline if self.marks(lb) > 0 else deadline + grace
+            if now - v.value > limit:
+                out.append(lb)
+        return out
 
 
 class RestartBudget:

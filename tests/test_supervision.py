@@ -1,9 +1,13 @@
 """RestartBudget — the shared crash-loop policy behind runtime._monitor
-and fleet.run_fleet_actors."""
+and fleet.run_fleet_actors — plus the exit-code vocabulary."""
 
 from __future__ import annotations
 
-from pytorch_distributed_tpu.utils.supervision import RestartBudget
+import time
+
+from pytorch_distributed_tpu.utils.supervision import (
+    EXIT_DISCONNECTED, EXIT_HUNG, EXIT_OK, RestartBudget, describe_exit,
+)
 
 
 def test_budget_exhausts_then_refuses():
@@ -38,6 +42,46 @@ def test_backoff_grows_and_caps():
     delays = [b.request_restart(0) for _ in range(6)]
     assert delays[:4] == [2.0, 4.0, 8.0, 16.0]
     assert delays[4] == 30.0 and delays[5] == 30.0
+
+
+def test_backoff_caps_below_two_seconds():
+    # max_backoff below the 2 s base must clamp the FIRST delay too
+    b = RestartBudget(max_restarts=5, backoff=True, max_backoff=0.5)
+    b.note_birth(0)
+    assert b.request_restart(0) == 0.5
+    assert b.request_restart(0) == 0.5
+
+
+def test_backoff_resets_after_grace():
+    # an incarnation that outlives the grace period proves the previous
+    # crash isolated: the budget AND the exponential ladder restart
+    b = RestartBudget(max_restarts=4, grace=0.05, backoff=True,
+                      max_backoff=30.0)
+    b.note_birth(0)
+    assert b.request_restart(0) == 2.0
+    b.note_birth(0)
+    assert b.request_restart(0) == 4.0
+    b.note_birth(0)
+    time.sleep(0.06)  # this incarnation lived past the grace window
+    assert b.request_restart(0) == 2.0  # ladder back at the base
+    assert b.count(0) == 1
+
+
+def test_backoff_does_not_reset_within_grace():
+    b = RestartBudget(max_restarts=4, grace=300.0, backoff=True)
+    b.note_birth(0)
+    assert b.request_restart(0) == 2.0
+    b.note_birth(0)  # young incarnation: crash loop continues
+    assert b.request_restart(0) == 4.0
+    assert b.count(0) == 2
+
+
+def test_describe_exit_vocabulary():
+    assert describe_exit(EXIT_OK) == "exit 0 (run complete)"
+    assert "DCN session lost" in describe_exit(EXIT_DISCONNECTED)
+    assert describe_exit(EXIT_HUNG) == "exit 4 (hung; watchdog killed)"
+    assert describe_exit(-9) == "signal 9"
+    assert "crash" in describe_exit(1)
 
 
 def test_unborn_slot_grants_without_reset():

@@ -15,7 +15,14 @@ wire.  Exits nonzero on any invariant violation:
   backwards (the tell for answering a stale/ghost gateway);
 - **lost experience** — a chunk the wire acknowledged that never reached
   ``put_chunk`` (duplicates are legal — delivery is at-least-once — loss
-  is not).
+  is not);
+- **poison delivered** — a non-finite reward reached ``put_chunk``: the
+  soak mixes deliberately poisoned chunks (NaN reward/priority, the
+  health sentinel's ``poison_chunk`` fault) into every actor's schedule
+  and the gateway's ingest quarantine must divert ALL of them;
+- **stall mishandled** — one seeded actor freezes mid-run for several
+  heartbeat intervals (the hang-adjacent stall): its session must ride
+  through on heartbeats, never end disconnected.
 
 Usage:
     python tools/chaos_soak.py --seconds 30 --actors 4 --seed 0
@@ -59,15 +66,23 @@ def tagged_transition(tag: int) -> Transition:
 
 class ChunkLog:
     """Gateway-side ``put_chunk`` sink: records the id tag of every
-    delivered transition (thread-safe — serve threads race into it)."""
+    delivered transition (thread-safe — serve threads race into it).
+    Non-finite rewards — poisoned chunks the quarantine should have
+    diverted — are counted as ``poisoned_delivered``, the soak's
+    replay-cleanliness invariant."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.tags: List[int] = []
+        self.poisoned_delivered = 0
 
     def __call__(self, items: list) -> None:
         with self._lock:
-            self.tags.extend(int(t.reward) for t, _p in items)
+            for t, _p in items:
+                if not np.isfinite(t.reward):
+                    self.poisoned_delivered += 1
+                else:
+                    self.tags.append(int(t.reward))
 
     def seen(self) -> Dict[int, int]:
         with self._lock:
@@ -85,14 +100,20 @@ class SyntheticActor:
     set the gateway must cover) and how the loop ended."""
 
     def __init__(self, address, slot: int, steps: int = 10 ** 9,
-                 client_kwargs: Optional[dict] = None, pace: float = 0.0):
+                 client_kwargs: Optional[dict] = None, pace: float = 0.0,
+                 poison_every: int = 0, stall_at: int = -1,
+                 stall_s: float = 0.0):
         self.address = address
         self.slot = slot
         self.steps = steps
         self.pace = pace
+        self.poison_every = poison_every  # every Nth chunk ships NaN
+        self.stall_at = stall_at          # chunk index of a long freeze
+        self.stall_s = stall_s
         self.client_kwargs = client_kwargs or {}
         self.client: Optional[DcnClient] = None
         self.acked_tags: List[int] = []
+        self.poisoned_sent = 0
         self.step_regressions = 0
         self.outcome: Optional[str] = None  # "stopped"|"disconnected"|err
         self.thread: Optional[threading.Thread] = None
@@ -117,10 +138,23 @@ class SyntheticActor:
         last_step = -1
         try:
             while not rclock.done(self.steps):
+                if i == self.stall_at and self.stall_s > 0:
+                    # alive-but-quiet freeze: heartbeats must keep the
+                    # session claimed through it (hang-adjacent stall)
+                    time.sleep(self.stall_s)
                 tag = (self.slot << 20) | i
-                client.send_chunk(
-                    [(tagged_transition(tag), None)])  # acked iff returns
-                self.acked_tags.append(tag)
+                if self.poison_every and i and i % self.poison_every == 0:
+                    # the poison_chunk fault, wire edition: NaN reward +
+                    # NaN priority — must be quarantined at the gateway,
+                    # never delivered (tag is NOT expected in the log)
+                    t = tagged_transition(tag)
+                    t = t._replace(reward=np.float32(np.nan))
+                    client.send_chunk([(t, float("nan"))])
+                    self.poisoned_sent += 1
+                else:
+                    client.send_chunk(
+                        [(tagged_transition(tag), None)])  # acked iff returns
+                    self.acked_tags.append(tag)
                 rclock.add_actor_steps(1)
                 if i % 8 == 0:
                     rparams.fetch(0)
@@ -153,6 +187,7 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
          restart_every: Optional[float] = 5.0,
          fault_rates: Optional[Dict[str, float]] = None,
          reconnect_timeout: float = 10.0,
+         poison_every: int = 40,
          verbose: bool = True) -> dict:
     """Run the randomized soak; returns a report dict whose
     ``violations`` list is empty on a healthy session layer."""
@@ -167,11 +202,21 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
     port = gw.port
     violations: List[str] = []
     fenced = 0
+    quarantined = 0
     gateway_restarts = 0
 
+    # one seeded actor gets a mid-run freeze of several heartbeat
+    # intervals — the hang-adjacent stall the session layer must ride
+    # through (the full hang->SIGKILL->respawn ladder needs a process
+    # supervisor and is drilled by tests/test_health.py)
+    stall_slot = int(rng.integers(actors)) if actors else -1
     fleet = [
         SyntheticActor(
             ("127.0.0.1", port), slot=i, pace=0.002,
+            poison_every=poison_every,
+            stall_at=(50 + int(rng.integers(100))
+                      if i == stall_slot else -1),
+            stall_s=2.5,
             client_kwargs=dict(
                 reconnect_timeout=reconnect_timeout,
                 heartbeat_interval=0.5,
@@ -205,6 +250,7 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
                 inc, incarnation_high.get(slot, 0))
         if time.monotonic() >= next_restart:
             fenced += gw.fenced
+            quarantined += sum(gw.quarantined.values())
             gw.close()
             gateway_restarts += 1
             gw = DcnGateway(store, clock, stats, put_chunk=log,
@@ -225,6 +271,7 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
             violations.append(f"actor {a.slot} saw the learner clock "
                               f"regress {a.step_regressions}x")
     fenced += gw.fenced
+    quarantined += sum(gw.quarantined.values())
     gw.close()
 
     seen = log.seen()
@@ -233,6 +280,15 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
     if lost:
         violations.append(f"{len(lost)} acked chunks never delivered "
                           f"(first: {lost[:5]})")
+    poisoned_sent = sum(a.poisoned_sent for a in fleet)
+    if log.poisoned_delivered:
+        violations.append(
+            f"{log.poisoned_delivered} poisoned transitions reached "
+            f"put_chunk (quarantine breached)")
+    if poisoned_sent and not quarantined:
+        violations.append(
+            f"{poisoned_sent} poisoned chunks sent but the gateway "
+            f"quarantined none")
     report = {
         "violations": violations,
         "actors": actors,
@@ -242,6 +298,9 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
         "reconnects": sum(a.client.reconnects for a in fleet if a.client),
         "injected_faults": sum(
             a.client_kwargs["faults"].injected for a in fleet),
+        "poisoned_sent": poisoned_sent,
+        "poisoned_delivered": log.poisoned_delivered,
+        "quarantined": quarantined,
         "gateway_restarts": gateway_restarts,
         "fenced": fenced,
         "final_learner_step": learner_step,
@@ -268,10 +327,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="mean seconds between gateway kill+rebinds "
                          "(0 disables)")
     ap.add_argument("--reconnect-timeout", type=float, default=10.0)
+    ap.add_argument("--poison-every", type=int, default=40,
+                    help="every Nth chunk per actor ships NaN "
+                         "reward/priority (0 disables); the gateway "
+                         "quarantine must divert every one")
     args = ap.parse_args(argv)
     report = soak(seconds=args.seconds, actors=args.actors, seed=args.seed,
                   restart_every=args.restart_every or None,
-                  reconnect_timeout=args.reconnect_timeout)
+                  reconnect_timeout=args.reconnect_timeout,
+                  poison_every=args.poison_every)
     ok = not report["violations"]
     print(f"[chaos] {'OK' if ok else 'FAILED'} after {args.seconds:.0f}s: "
           f"{len(report['violations'])} violations")

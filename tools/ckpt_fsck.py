@@ -18,6 +18,13 @@ Per epoch it reports one of:
 - ``incomplete`` — no MANIFEST.json: a save was killed before its atomic
   commit.  Expected crash debris, NOT a violation (the next run's save
   clears it); an older complete epoch still carries the run.
+- ``rolled-back`` — committed but fenced off by a health-sentinel
+  rollback (``ROLLED_BACK.json``): its params are suspected diverged, so
+  resume skips it.  Clean, NOT a violation — a run that rolled back
+  mid-training fscks with exit 0, and the learner_step regression its
+  successor epochs carry is legal exactly because the overtaken epochs
+  are marked (an UNMARKED step regression between complete epochs is
+  still flagged).
 - ``corrupt``    — a committed manifest is lying (missing artifact, digest
   mismatch, inconsistent learner_step).  Every lie is listed and counted
   as a violation.
@@ -73,11 +80,16 @@ def main(argv=None) -> int:
         for e in sorted(rep["epochs"], key=lambda e: e["epoch"]):
             line = f"[ckpt_fsck] {rep['root']} epoch {e['epoch']}: " \
                    f"{e['status']}"
-            if e["status"] == "complete":
+            if e["status"] in ("complete", "rolled-back") \
+                    and e.get("learner_step") is not None:
                 line += f" (learner_step {e.get('learner_step')})"
             print(line)
             for v in e["violations"]:
                 print(f"[ckpt_fsck]   VIOLATION: {v}")
+        if rep.get("rolled_back"):
+            print(f"[ckpt_fsck] {rep['root']}: {rep['rolled_back']} "
+                  f"epoch(s) fenced by health-sentinel rollback "
+                  f"(kept as post-mortem evidence; never resumed from)")
         if rep["violations"]:
             rc = max(rc, 1)
         if args.require_complete and rep["newest_complete"] is None:

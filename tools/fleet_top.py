@@ -68,6 +68,23 @@ def render(status: dict) -> str:
                  f" · chunks {status.get('chunks_in', 0)}"
                  f" · fenced {status.get('fenced', 0)}")
     lines.append("  " + "   ".join(parts))
+    # health sentinel (utils/health.py): guard skips / rollbacks / hang
+    # kills from the learner host, quarantine counts split by boundary —
+    # the gateway's per-slot counts name WHICH remote actor is poisoning
+    sentinel = status.get("health_sentinel") or {}
+    quarantined = status.get("quarantined") or {}
+    q_local = sentinel.get("quarantined_local") or {}
+    if sentinel or quarantined or status.get("frames_rejected"):
+        bits = [f"skipped {sentinel.get('skipped_steps', 0)}",
+                f"rollbacks {sentinel.get('rollbacks', 0)}",
+                f"hang kills {sentinel.get('hang_kills', 0)}",
+                f"frames rejected {status.get('frames_rejected', 0)}"]
+        q_all = {**{f"local:{k}": v for k, v in q_local.items()},
+                 **{f"dcn:{k}": v for k, v in quarantined.items()}}
+        bits.append("quarantined "
+                    + (", ".join(f"{k}={v}" for k, v in sorted(
+                        q_all.items())) if q_all else "0"))
+        lines.append("  health: " + " · ".join(bits))
     slots = status.get("slots", {})
     lines.append("")
     lines.append(f"  {'slot':>6} {'incarnation':>16} {'heartbeat':>10}")
