@@ -75,7 +75,17 @@ reference publishes no throughput numbers (BASELINE.md "published
 frames/sec: none"), so this basis is self-declared; the ``*_basis`` field
 says so explicitly.
 
-Usage: ``python bench.py [--mode micro|families|e2e|both]``
+Two rider sections measure the in-graph/host guards' cost on the fused
+flagship program: ``health_overhead`` (the ISSUE-5 in-jit finite guard)
+and ``perf_overhead`` (the ISSUE-6 live PerfMonitor doing its production
+accounting) — both must stay <2% of median step time.
+
+``--smoke`` is a separate seconds-scale CPU-safe mode (the dqn-mlp fused
+program only) whose one-line JSON feeds ``tools/bench_gate.py --against
+BENCH_SMOKE_BASELINE.json`` and ``BENCH_HISTORY.jsonl`` — the perf
+regression gate CI runs (TESTING.md "Bench regression gate").
+
+Usage: ``python bench.py [--mode micro|families|e2e|both] [--smoke]``
 (default both = all three).
 """
 
@@ -108,24 +118,12 @@ MICRO_BATCH = 128
 MICRO_DISPATCH = 32
 MICRO_DISPATCH_PEAK = 256
 
-# Peak dense bf16 FLOP/s per chip by device_kind, for the MFU estimate.
-# Public figures; unknown kinds report achieved FLOP/s with mfu=null.
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5e": 197e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6e": 918e12,
-    "TPU v6 lite": 918e12,
-}
-
-
-def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "") or ""
-    for name, peak in PEAK_FLOPS.items():
-        if kind.lower().startswith(name.lower()):
-            return peak
-    return None
+# Peak FLOP/s table + the XLA cost-analysis FLOPs extraction now live in
+# utils/perf.py (the live perf plane shares them with this bench and
+# tools/mfu_probe.py — previously three inline copies).
+from pytorch_distributed_tpu.utils.perf import (  # noqa: E402
+    PEAK_FLOPS, flops_of_compiled, peak_flops_of as _peak_flops,
+)
 
 
 def bench_micro() -> dict:
@@ -216,14 +214,7 @@ def bench_micro() -> dict:
         # identical flops for K=1/8/64), so the figure is per-update.
         compiled = fused.lower(state, ring.state, keymat()).compile()
         if flops_per_update is None:
-            try:
-                cost = compiled.cost_analysis()
-                c = cost[0] if isinstance(cost, (list, tuple)) else cost
-                f = (c or {}).get("flops")
-                if f and f > 0:
-                    flops_per_update = float(f)
-            except Exception:  # noqa: BLE001 - best-effort
-                pass
+            flops_per_update = flops_of_compiled(compiled)
 
         # warmup: enough dispatches to settle the link (a tunnelled dev
         # chip's first dispatches pay connection setup)
@@ -451,17 +442,9 @@ def bench_families() -> dict:
                 state, metrics = compiled(state, ring.state, keymat())
                 return metrics
 
-        flops = None
-        try:
-            # scan bodies are counted once by cost_analysis (verified in
-            # bench_micro across K=1/8/64), so this is per-update
-            cost = compiled.cost_analysis()
-            c = cost[0] if isinstance(cost, (list, tuple)) else cost
-            f = (c or {}).get("flops")
-            if f and f > 0:
-                flops = float(f)
-        except Exception:  # noqa: BLE001 - best-effort
-            pass
+        # scan bodies are counted once by cost_analysis (verified in
+        # bench_micro across K=1/8/64), so this is per-update
+        flops = flops_of_compiled(compiled)
         for _ in range(5):  # warmup + link settle
             metrics = dispatch()
         float(jax.device_get(metrics["learner/critic_loss"]))
@@ -731,6 +714,227 @@ def bench_health_overhead(windows: int = 6,
     return {"health_overhead": out}
 
 
+def _mlp_fused_program(B: int, K: int):
+    """The dqn-mlp learner program fused over a small uniform ring —
+    the CPU-safe geometry shared by ``bench_smoke`` and the smoke
+    variant of ``bench_perf_overhead`` (the flagship CNN takes minutes
+    to compile on a CPU host; the MLP takes seconds).  Returns
+    ``(fused, state, ring)``."""
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.factory import (
+        build_model, build_train_state_and_step, init_params, probe_env,
+    )
+    from pytorch_distributed_tpu.memory.device_replay import (
+        DeviceReplay, build_uniform_fused_step,
+    )
+    from pytorch_distributed_tpu.utils.experience import Transition
+
+    opt = build_options(1, batch_size=B)  # dqn-mlp on the fake chain env
+    spec = probe_env(opt)
+    model = build_model(opt, spec)
+    params = init_params(opt, spec, model, seed=0)
+    state, step = build_train_state_and_step(opt, spec, model, params,
+                                             mesh=None)
+    rng = np.random.default_rng(0)
+    ring = DeviceReplay(256, spec.state_shape, spec.action_shape,
+                        state_dtype=np.float32,
+                        action_dtype=spec.action_dtype)
+    C = 64
+    for _ in range(ring.capacity // C):
+        ring.feed_chunk(Transition(
+            state0=rng.normal(size=(C, *spec.state_shape)).astype(
+                np.float32),
+            action=rng.integers(0, spec.num_actions, C).astype(np.int32),
+            reward=rng.normal(size=C).astype(np.float32),
+            gamma_n=np.full(C, 0.99 ** 5, np.float32),
+            state1=rng.normal(size=(C, *spec.state_shape)).astype(
+                np.float32),
+            terminal1=(rng.random(C) < 0.1).astype(np.float32)))
+    fused = build_uniform_fused_step(step, B, steps_per_call=K,
+                                     donate=False)
+    return fused, state, ring
+
+
+def bench_perf_overhead(windows: int = 6,
+                        updates_per_window: int = 512,
+                        smoke: bool = False) -> dict:
+    """Perf-plane monitor cost (ISSUE 6 acceptance): the SAME fused
+    flagship learner program as bench_micro (batch-128 Nature-CNN over
+    an HBM ring, K=32 scanned updates per dispatch) measured with a live
+    ``utils/perf.PerfMonitor`` doing its production accounting — one
+    ``note_updates`` per dispatch plus a ``drain()`` + JSONL flush per
+    window, exactly the learner's stats-cadence wiring — vs bare.  The
+    monitor's hot-path surface is one integer add, so the acceptance
+    bar is ``perf_overhead_frac`` < 0.02 of median step time.  Both
+    variants use the fetch-bounded window timing bench_micro documents.
+
+    ``smoke=True`` swaps in the CPU-safe dqn-mlp geometry (shared with
+    ``bench_smoke``) so the measurement logic itself is CI-exercisable —
+    the flagship CNN program takes minutes to compile on a CPU host."""
+    import jax
+
+    from pytorch_distributed_tpu.config import PerfParams
+    from pytorch_distributed_tpu.utils import perf
+    from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+
+    if smoke:
+        B, K = 32, 8
+        fused, state0, ring = _mlp_fused_program(B, K)
+    else:
+        from pytorch_distributed_tpu.memory.device_replay import (
+            DeviceReplay, build_uniform_fused_step, round_capacity,
+        )
+        from pytorch_distributed_tpu.models import DqnCnnModel
+        from pytorch_distributed_tpu.ops.losses import (
+            build_dqn_train_step, init_train_state, make_optimizer,
+        )
+        from pytorch_distributed_tpu.utils.experience import Transition
+
+        B, K = MICRO_BATCH, MICRO_DISPATCH
+        model = DqnCnnModel(action_space=6, norm_val=255.0)
+        params = model.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 4, 84, 84), dtype=np.uint8))
+        tx = make_optimizer(lr=1e-4)
+        ring = DeviceReplay(capacity=round_capacity(2048, None),
+                            state_shape=(4, 84, 84), state_dtype=np.uint8)
+        rng = np.random.default_rng(0)
+        C = 512
+        for _ in range(ring.capacity // C):
+            ring.feed_chunk(Transition(
+                state0=rng.integers(0, 255, (C, 4, 84, 84)).astype(
+                    np.uint8),
+                action=rng.integers(0, 6, C).astype(np.int32),
+                reward=rng.normal(size=C).astype(np.float32),
+                gamma_n=np.full(C, 0.99 ** 5, dtype=np.float32),
+                state1=rng.integers(0, 255, (C, 4, 84, 84)).astype(
+                    np.uint8),
+                terminal1=(rng.random(C) < 0.1).astype(np.float32)))
+        step = build_dqn_train_step(model.apply, tx,
+                                    target_model_update=250)
+        fused = build_uniform_fused_step(step, B, steps_per_call=K,
+                                         donate=False)
+        state0 = init_train_state(params, tx)
+
+    key = jax.random.PRNGKey(0)
+
+    def keymat():
+        nonlocal key
+        key, sub = jax.random.split(key)
+        return jax.random.split(sub, K)
+
+    # ONE compile shared by both variants (donate=False keeps state0
+    # reusable): the measurement is of the monitor, not the compiler
+    compiled = fused.lower(state0, ring.state, keymat()).compile()
+    flops = flops_of_compiled(compiled)
+
+    def measure(monitored: bool) -> float:
+        state = state0
+        monitor, writer, mstep = None, None, 0
+        if monitored:
+            monitor = perf.PerfMonitor(
+                "bench", PerfParams(enabled=True), prefix="learner")
+            # immune to ambient TPU_APEX_PERF=0 (resolve() lets env
+            # override the explicit params): a disabled monitor would
+            # measure bare-vs-bare and report a vacuous 0% overhead
+            monitor.enabled = True
+            monitor.flops_per_update = flops
+            monitor.register_jit("fused_step",
+                                 getattr(fused, "_cache_size", None))
+            writer = MetricsWriter(
+                tempfile.mkdtemp(prefix="bench_perf_"),
+                enable_tensorboard=False, role="learner")
+            monitor.drain()  # anchor
+        for _ in range(5):
+            state, metrics = compiled(state, ring.state, keymat())
+        float(jax.device_get(metrics["learner/critic_loss"]))
+        iters, rates = max(updates_per_window // K, 2), []
+        for _ in range(windows):
+            keysets = [keymat() for _ in range(iters)]
+            jax.block_until_ready(keysets[-1])
+            t0 = time.perf_counter()
+            for ks in keysets:
+                state, metrics = compiled(state, ring.state, ks)
+                if monitored:
+                    monitor.note_updates(K)
+            if monitored:
+                mstep += iters * K
+                writer.scalars(monitor.drain(step=mstep), step=mstep)
+            float(jax.device_get(metrics["learner/critic_loss"]))
+            rates.append(iters * K / (time.perf_counter() - t0))
+        if writer is not None:
+            writer.close()
+        return float(np.median(rates))
+
+    bare = measure(False)
+    monitored = measure(True)
+    frac = (bare - monitored) / bare if bare > 0 else None
+    out = {
+        "updates_per_sec_monitored": round(monitored, 2),
+        "updates_per_sec_bare": round(bare, 2),
+        # clamped at 0: window noise routinely makes the monitored run
+        # measure FASTER on a noisy host; negative overhead is noise
+        "perf_overhead_frac": (round(max(frac, 0.0), 4)
+                               if frac is not None else None),
+        "steps_per_dispatch": K,
+        "batch_size": B,
+        "geometry": "smoke-mlp" if smoke else "flagship-cnn",
+    }
+    print(f"[bench_perf_overhead] {out}", file=sys.stderr, flush=True)
+    return {"perf_overhead": out}
+
+
+def bench_smoke(updates: int = 384) -> dict:
+    """Seconds-scale, CPU-safe bench for CI gating (ISSUE 6 satellite):
+    the dqn-mlp learner program fused over a small uniform HBM-style
+    ring — tiny enough to compile and run in seconds on a CPU host,
+    production-shaped enough (fused sample+train scan, fetch-bounded
+    windows, XLA-derived flops) that a real regression in the core
+    train-step machinery moves it.  The output feeds
+    ``tools/bench_gate.py --against BENCH_SMOKE_BASELINE.json`` and is
+    recorded into ``BENCH_HISTORY.jsonl`` — perf as a CI check, not an
+    offline artifact.  Absolute rates are machine-dependent; gate smoke
+    runs against a SAME-MACHINE baseline/history (the checked-in
+    baseline documents this image's figures)."""
+    import jax
+
+    B, K = 32, 8
+    fused, state, ring = _mlp_fused_program(B, K)
+    key = jax.random.PRNGKey(0)
+
+    def keymat():
+        nonlocal key
+        key, sub = jax.random.split(key)
+        return jax.random.split(sub, K)
+
+    t_compile = time.perf_counter()
+    compiled = fused.lower(state, ring.state, keymat()).compile()
+    t_compile = time.perf_counter() - t_compile
+    flops = flops_of_compiled(compiled)
+    for _ in range(3):
+        state, metrics = compiled(state, ring.state, keymat())
+    float(jax.device_get(metrics["learner/critic_loss"]))
+    windows, rates = 4, []
+    iters = max(updates // (4 * K), 1)
+    for _ in range(windows):
+        keysets = [keymat() for _ in range(iters)]
+        jax.block_until_ready(keysets[-1])
+        t0 = time.perf_counter()
+        for ks in keysets:
+            state, metrics = compiled(state, ring.state, ks)
+        float(jax.device_get(metrics["learner/critic_loss"]))
+        rates.append(iters * K / (time.perf_counter() - t0))
+    out = {
+        "updates_per_sec": round(float(np.median(rates)), 2),
+        "batch_size": B,
+        "steps_per_dispatch": K,
+        "compile_seconds": round(t_compile, 2),
+    }
+    if flops:
+        out["flops_per_update"] = round(flops)
+    print(f"[bench_smoke] {out}", file=sys.stderr, flush=True)
+    return {"smoke": out}
+
+
 def bench_actor_pipeline(envs: int = 16, ticks: int = 300) -> dict:
     """Actor hot-loop section (ISSUE 4): serial vs software-pipelined
     schedules on the production actor shape (pong-sim vector, Nature-CNN
@@ -942,8 +1146,13 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("micro", "e2e", "both", "families",
                                        "sampler", "act", "actor",
-                                       "health"),
+                                       "health", "perf"),
                     default="both")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale CPU-safe bench (the dqn-mlp "
+                         "fused learner program only) for CI gating: "
+                         "pipe the JSON into tools/bench_gate.py "
+                         "--against BENCH_SMOKE_BASELINE.json")
     ap.add_argument("--e2e-seconds", type=float, default=60.0)
     ap.add_argument("--e2e-actors", type=int, default=1)
     ap.add_argument("--e2e-envs", type=int, default=16)
@@ -965,6 +1174,21 @@ def main() -> None:
     enable_compile_cache()
 
     result = {}
+    if args.smoke:
+        result.update(bench_smoke())
+        out = {
+            "bench_schema": 3,
+            "metric": "smoke_updates_per_sec",
+            "value": result["smoke"]["updates_per_sec"],
+            "unit": ("updates/s (dqn-mlp fused x8, smoke geometry — "
+                     "machine-local figure, gate against same-machine "
+                     "history)"),
+            "mode": "smoke",
+            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        }
+        out.update(result)
+        print(json.dumps(out))
+        return
     if args.mode in ("micro", "both"):
         result.update(bench_micro())
     if args.mode in ("both", "families"):
@@ -975,6 +1199,8 @@ def main() -> None:
         result.update(bench_act_ab())
     if args.mode in ("both", "health"):
         result.update(bench_health_overhead())
+    if args.mode in ("both", "perf"):
+        result.update(bench_perf_overhead())
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))

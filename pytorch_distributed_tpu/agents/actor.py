@@ -161,7 +161,7 @@ class _ActorHarness:
         self._next_flush = self.ap.actor_freq
         self._next_sync = self.ap.actor_sync_freq
 
-        from pytorch_distributed_tpu.utils import tracing
+        from pytorch_distributed_tpu.utils import perf, tracing
         from pytorch_distributed_tpu.utils.faults import FaultInjector
         from pytorch_distributed_tpu.utils.metrics import MetricsWriter
         from pytorch_distributed_tpu.utils.profiling import StepTimer
@@ -180,6 +180,12 @@ class _ActorHarness:
         self._timing_writer = MetricsWriter(
             opt.log_dir, enable_tensorboard=False,
             role=f"actor-{process_ind}", run_id=opt.refs)
+        # perf plane (utils/perf.py, TPU_APEX_PERF=1): env-frames/s +
+        # memory watermarks on the actor_freq cadence; tags stay
+        # "actor/..." (fleet-comparable), rows carry this process's role
+        self.perf = perf.get_monitor(f"actor-{process_ind}",
+                                     opt.perf_params, prefix="actor")
+        self.perf.drain()  # anchor the first rate window at startup
         # distributed-trace origin: every chunk this actor flushes is
         # stamped with a trace id here and records an "enqueue" span (a
         # blocking put IS backpressure); downstream hops — gateway, feed,
@@ -200,6 +206,7 @@ class _ActorHarness:
         satellite)."""
         N = self.num_envs
         self.env_steps += N
+        self.perf.note_frames(N)  # one int add; no-op when disabled
         self.clock.add_actor_steps(N)  # reference dqn_actor.py:166-167
         self._bump_progress(self._progress_label)  # watchdog liveness
         self._faults.data_frame(())  # ACTOR_FAULTS: hang@N / crash@N
@@ -264,6 +271,9 @@ class _ActorHarness:
             self.flush_stats()
             step = self.clock.learner_step.value
             self._timing_writer.scalars(self.timer.drain(), step=step)
+            if self.perf.enabled:
+                self._timing_writer.scalars(self.perf.drain(step=step),
+                                            step=step)
             self.tracer.flush_to(self._timing_writer, step=step)
             if hasattr(self.memory, "flush"):
                 self.memory.flush()  # queue feeders drain on the cadence
@@ -338,6 +348,11 @@ class _ActorHarness:
 
         if isinstance(self.memory, QueueFeeder):
             self.memory.close()
+        if self.perf.enabled:
+            # final partial window: bounded runs still export a rate
+            self._timing_writer.scalars(
+                self.perf.drain(step=self.clock.learner_step.value),
+                step=self.clock.learner_step.value)
         self.tracer.flush_to(self._timing_writer,
                              step=self.clock.learner_step.value)
         self._timing_writer.close()
@@ -495,6 +510,10 @@ def _drive_actor_loop(h: _ActorHarness, engine, clock: GlobalClock,
     """
     timer = h.timer
     h.engine = engine  # introspection: bench/tests read jit_cache_size
+    # retrace detector: the fused act program must never recompile
+    # after warmup (batched engines return None — the jit lives
+    # server-side and the server registers its own)
+    h.perf.register_jit("act", engine.jit_cache_size)
     h.start()
     tick = 0
     reset_mask = np.zeros(h.num_envs, dtype=bool)

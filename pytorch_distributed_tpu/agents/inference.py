@@ -186,6 +186,13 @@ class InferenceServer:
         # owns the server (bench reads them off the object directly)
         self.stats = {"requests": 0, "batches": 0, "rows": 0,
                       "widest_batch": 0, "param_refreshes": 0}
+        # perf plane (utils/perf.py): served-rows counter + retrace
+        # watch on the server's jits; lands in the T_STATUS ``perf``
+        # block via the process registry (the server lives in the
+        # gateway's process, so no extra plumbing)
+        from pytorch_distributed_tpu.utils import perf
+
+        self.perf = perf.get_monitor("inference", opt.perf_params)
 
     # -- wiring (parent process, before spawn) ------------------------------
 
@@ -270,6 +277,12 @@ class InferenceServer:
             return fn
 
         self._expander = expander
+        self.perf.register_jit("act_single",
+                               getattr(self._act_single, "_cache_size",
+                                       None))
+        self.perf.register_jit("act_rows",
+                               getattr(self._act_rows, "_cache_size",
+                                       None))
 
     def _refresh_params(self, block: bool) -> None:
         """Pull the newest published weights onto the device.  Blocking
@@ -297,9 +310,28 @@ class InferenceServer:
     # -- serve loop ---------------------------------------------------------
 
     def _serve(self) -> None:
+        perf_writer = None
+        last_perf = time.monotonic()
         try:
             self._build()
+            if self.perf.enabled:
+                # the server owns no stats cadence of its own, so the
+                # serve loop drains its monitor every ~15 s — without
+                # this the registered retrace watch never runs and the
+                # served-frames rate never reaches the metrics stream
+                from pytorch_distributed_tpu.utils.metrics import (
+                    MetricsWriter,
+                )
+
+                perf_writer = MetricsWriter(
+                    self.opt.log_dir, enable_tensorboard=False,
+                    role="inference", run_id=self.opt.refs)
+                self.perf.drain()  # anchor past the build compiles
             while not self._stop.is_set():
+                if perf_writer is not None \
+                        and time.monotonic() - last_perf >= 15.0:
+                    last_perf = time.monotonic()
+                    perf_writer.scalars(self.perf.drain(), step=0)
                 try:
                     first = self._req_q.get(timeout=0.2)
                 except _queue.Empty:
@@ -323,6 +355,7 @@ class InferenceServer:
                 self.stats["rows"] += rows
                 self.stats["widest_batch"] = max(
                     self.stats["widest_batch"], rows)
+                self.perf.note_frames(rows)
                 # Frame-packed requests carry per-client device state
                 # (the roll stack), so they dispatch as one small fused
                 # program per client — ALL issued asynchronously first,
@@ -359,6 +392,10 @@ class InferenceServer:
                     pass
             if not self._stop.is_set():
                 raise
+        finally:
+            if perf_writer is not None:
+                perf_writer.scalars(self.perf.drain(), step=0)
+                perf_writer.close()
 
     def _begin_packed(self, req: Tuple):
         """Dispatch one frame-packed request WITHOUT syncing: roll the

@@ -46,7 +46,9 @@ from pytorch_distributed_tpu.memory.device_sequence import (
 )
 from pytorch_distributed_tpu.memory.feeder import QueueOwner
 from pytorch_distributed_tpu.utils import checkpoint as ckpt
-from pytorch_distributed_tpu.utils import flight_recorder, health, tracing
+from pytorch_distributed_tpu.utils import (
+    flight_recorder, health, perf, tracing,
+)
 from pytorch_distributed_tpu.utils.faults import FaultInjector
 from pytorch_distributed_tpu.utils.metrics import MetricsWriter
 from pytorch_distributed_tpu.utils.profiling import StepTimer
@@ -234,6 +236,12 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                                         DeviceSequenceIngest))
     is_device = isinstance(memory, DeviceReplayIngest) and not is_device_per
     on_device = is_device or is_device_per
+    # perf plane monitor (utils/perf.py, TPU_APEX_PERF=1): created for
+    # every memory path — rates/watermarks/gauges work everywhere; the
+    # FLOPs capture below is device-path only (the host path's step
+    # runs through ShardedLearner, whose per-update FLOPs nobody
+    # dispatch-amortizes)
+    perf_mon = perf.get_monitor("learner", opt.perf_params)
     if on_device:
         # Attach the HBM ring on the learner's mesh and fuse sampling (and
         # for PER: priority write-back) into the train step — one XLA
@@ -285,6 +293,39 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                     nonlocal state
                     state, m, _td = fused(state, replay.state, key)
                     return m
+
+        # Capture the fused program's per-update FLOPs off its cost
+        # analysis ONCE at startup — the same executable the loop
+        # dispatches (the AOT lower/compile below dedups through the
+        # persistent compile cache on TPU) — so live MFU is one
+        # multiply per stats window.  The jit cache handle feeds the
+        # retrace detector: this program must never recompile after
+        # warmup.
+        if perf_mon.enabled:
+            _pf = fused_per if is_device_per else fused
+            perf_mon.register_jit("fused_step",
+                                  getattr(_pf, "_cache_size", None))
+            _pkeys = jax.random.split(jax.random.PRNGKey(0), K + 1)[1:]
+            _pkeys = (_pkeys.reshape(K, *_pkeys.shape[1:]) if K > 1
+                      else _pkeys[0])
+            if is_device_per:
+                _pbeta = jax.device_put(np.float32(replay.beta(0)))
+                perf_mon.capture_flops(
+                    lambda: fused_per.lower(state, replay.state, _pkeys,
+                                            _pbeta))
+            else:
+                perf_mon.capture_flops(
+                    lambda: fused.lower(state, replay.state, _pkeys))
+        if perf_mon.audit is not None:
+            # transfer audit (opt-in): the fused dispatch is transfer-
+            # free by construction — state, ring and keys are all
+            # device-resident — so ANY implicit transfer it stages is a
+            # regression; the audit attributes it to its call site and
+            # retries with transfers allowed (utils/perf.TransferAudit)
+            _unaudited_step = device_step
+
+            def device_step(keys):  # noqa: F811 - deliberate rebind
+                return perf_mon.audit.run(_unaudited_step, keys)
 
         device_key = jax.random.PRNGKey(
             np_rng(opt.seed, "learner", process_ind).integers(2 ** 31))
@@ -477,6 +518,11 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
               f"{hp.max_rollbacks - _rb['used']} rollback(s) left",
               flush=True)
 
+    # anchor the first rate window at loop entry (not process start:
+    # warmup compiles must not dilute it); the anchor drain carries the
+    # one-time flops_per_update row + startup watermarks, so write it
+    if perf_mon.enabled:
+        timing_writer.scalars(perf_mon.drain(step=lstep), step=lstep)
     while lstep < ap.steps and not clock.stop.is_set() \
             and time.monotonic() < deadline:
         clock.bump_progress("learner")
@@ -577,6 +623,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         prev = lstep
         lstep += stride
         clock.set_learner_step(lstep)  # reference dqn_learner.py:94-95
+        perf_mon.note_updates(stride)  # one int add; no-op when disabled
         last_metrics = metrics
 
         # cadences fire on boundary crossings so a multi-step dispatch
@@ -646,6 +693,31 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             if hp.rollback and detector.should_rollback():
                 _rollback("+".join(anomalies) if anomalies
                           else "anomaly streak")
+            if perf_mon.enabled:
+                # throughput-attribution gauges the monitor can't see
+                # from inside: replay ratio on THIS run's steps (the
+                # pacing gate's own accounting) and how full the ingest
+                # queue is (1.0 = actors blocked on backpressure)
+                perf_mon.set_gauge(
+                    "learner/replay_ratio",
+                    (lstep - lstep0) * ap.batch_size
+                    / max(int(clock.actor_step.value), 1))
+                _q = getattr(memory, "_q", None)
+                if _q is not None and hasattr(_q, "qsize"):
+                    try:
+                        depth = int(_q.qsize())
+                        bound = int(getattr(memory, "max_queue_chunks",
+                                            0))
+                        perf_mon.set_gauge("learner/ingest_queue_depth",
+                                           depth)
+                        if bound:
+                            perf_mon.set_gauge(
+                                "learner/ingest_queue_util",
+                                depth / bound)
+                    except (NotImplementedError, OSError):
+                        pass  # macOS mp queues have no qsize
+                timing_writer.scalars(perf_mon.drain(step=lstep),
+                                      step=lstep)
             timing_writer.scalars(timer.drain(), step=lstep)
             _flush_traces(lstep)
             t_cadence = now
@@ -661,6 +733,9 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
         _pub_thread.join(timeout=120)
     _publish(state)
     _save_epoch()
+    if perf_mon.enabled:
+        # final partial window: short runs must still export their rates
+        timing_writer.scalars(perf_mon.drain(step=lstep), step=lstep)
     _flush_traces(lstep)  # tail spans of the final partial window
     timing_writer.close()
 

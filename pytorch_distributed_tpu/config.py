@@ -349,6 +349,51 @@ class HealthParams:
 
 
 @dataclass
+class PerfParams:
+    """Performance observability plane knobs (utils/perf.py; no
+    reference equivalent — the reference publishes no throughput
+    numbers at all, BASELINE.md).  Every field is env-overridable as
+    ``TPU_APEX_PERF_<FIELD>`` via ``perf.resolve`` (the bare
+    ``TPU_APEX_PERF=1`` shorthand maps to ``enabled``), the same
+    spawn-inheritance contract the health/fault planes use."""
+
+    # Master switch: continuously export learner MFU / updates-per-s,
+    # actor env-frames-per-s, replay-ratio and per-role memory
+    # watermarks as metrics rows on the normal cadences.  Off by
+    # default: the per-step cost is one counter add, but the one-time
+    # cost is an extra AOT compile of the fused step (for its
+    # cost_analysis FLOPs) at learner startup.
+    enabled: bool = False
+    # Peak dense FLOP/s per chip for the MFU ratio.  0 = auto from the
+    # device kind (utils/perf.PEAK_FLOPS); unknown kinds (CPU, new TPU
+    # generations) export achieved FLOP/s but no MFU row unless this is
+    # set explicitly (``TPU_APEX_PERF_PEAK_FLOPS=...``).
+    peak_flops: float = 0.0
+    # Per-role memory watermarks on the drain cadence: device
+    # live/peak bytes from ``device.memory_stats()`` where the backend
+    # reports them (TPU), host RSS current/peak everywhere.
+    memory_watermarks: bool = True
+    # Retrace detector: track the jit cache size of registered hot-path
+    # programs and flag any growth after the warmup window — a recompile
+    # after warmup means a shape/dtype leak is silently paying compile
+    # latency on the hot path.
+    retrace_detector: bool = True
+    # Opt-in transfer audit (``jax.transfer_guard``-based): run the
+    # fused learner dispatch under a disallow guard, attribute any
+    # IMPLICIT host<->device transfer to its python call site, then
+    # retry the dispatch with transfers allowed.  The fused hot path is
+    # transfer-free by construction, so any hit is a regression.
+    # Explicit ``device_put``s never trip it (they are intended by
+    # definition).
+    transfer_audit: bool = False
+    # Upper bound, seconds, on one on-demand T_PROFILE trace window
+    # (parallel/dcn.py): the verb is sessionless and unauthenticated
+    # inside the cluster, so a typo'd duration must not pin the
+    # profiler for an hour.
+    profile_window_max: float = 30.0
+
+
+@dataclass
 class ParallelParams:
     """TPU topology knobs — no reference equivalent (the reference is a
     single-node torch.multiprocessing program, SURVEY.md §2); this is where
@@ -421,6 +466,7 @@ class Options:
     agent_params: AgentParams = field(default_factory=AgentParams)
     parallel_params: ParallelParams = field(default_factory=ParallelParams)
     health_params: HealthParams = field(default_factory=HealthParams)
+    perf_params: PerfParams = field(default_factory=PerfParams)
 
     @property
     def model_dir(self) -> str:
@@ -512,7 +558,8 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
         assert key not in selectors  # popped above
         routed = False
         for sub in ("env_params", "memory_params", "model_params",
-                    "agent_params", "parallel_params", "health_params"):
+                    "agent_params", "parallel_params", "health_params",
+                    "perf_params"):
             subobj = getattr(opt, sub)
             if hasattr(subobj, key):
                 setattr(subobj, key, val)

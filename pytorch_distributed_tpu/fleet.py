@@ -88,6 +88,15 @@ class FleetTopology(Topology):
         self._rate_prev = None  # (monotonic, learner_step) of last probe
         self.gateway = self._make_gateway(port)
         self.port = self.gateway.port
+        if self.perf.enabled:
+            # warm the profiler's one-time session init NOW, while the
+            # learner is still compiling (GIL mostly released), so the
+            # first T_PROFILE answers at window speed — cold, it can
+            # take a minute+ on a saturated small host (utils/perf.
+            # prewarm_profiler has the measurement)
+            from pytorch_distributed_tpu.utils import perf
+
+            perf.prewarm_profiler()
 
     def _make_gateway(self, port: int):
         """Single construction point, shared with restart_gateway — a
@@ -101,7 +110,31 @@ class FleetTopology(Topology):
             self.param_store, self.clock, self.actor_stats,
             put_chunk=feed_queue_of(self.handles), port=port,
             local_actors=self.local_actors,
-            health=self._health_snapshot)
+            health=self._health_snapshot,
+            profiler=self._profile_request)
+
+    def _profile_request(self, msg: dict) -> dict:
+        """T_PROFILE provider (parallel/dcn.py): a bounded
+        ``utils/profiling.trace`` window captured from THIS process —
+        the learner host parent, which owns the accelerator, so the
+        trace shows the real XLA activity of the running learner (and
+        the co-located inference server / gateway threads).  Other
+        roles run in other processes (often other hosts) with no
+        profiler listener; asking for them is a clean error, not a
+        silently-wrong trace of the wrong process."""
+        from pytorch_distributed_tpu.utils import perf
+
+        role = str(msg.get("role", "learner"))
+        if role != "learner":
+            return {"error": f"role {role!r} not profilable over "
+                             f"T_PROFILE: only the learner host process "
+                             f"(the accelerator owner) captures XLA "
+                             f"traces"}
+        label = msg.get("label") or time.strftime("tprofile_%H%M%S")
+        return perf.run_profile_window(
+            os.path.join(self.opt.log_dir, "profiles"),
+            label=str(label), seconds=msg.get("seconds", 3.0),
+            max_seconds=self.perf.profile_window_max)
 
     def _health_snapshot(self) -> dict:
         """Topology-level fields for the gateway's STATUS verb: the parts
@@ -129,6 +162,7 @@ class FleetTopology(Topology):
                 pass  # macOS mp queues have no qsize
         now = time.monotonic()
         step = int(self.clock.learner_step.value)
+        astep = int(self.clock.actor_step.value)
         with self._rate_lock:
             prev = self._rate_prev
             # advance the window anchor only after it has real width:
@@ -136,10 +170,17 @@ class FleetTopology(Topology):
             # would otherwise shrink each other's windows to a few ms,
             # quantizing the rate into 0-or-thousands flapping
             if prev is None or now - prev[0] >= 0.5:
-                self._rate_prev = (now, step)
+                self._rate_prev = (now, step, astep)
         if prev is not None and now > prev[0]:
             h["learner_steps_per_sec"] = round(
                 (step - prev[1]) / (now - prev[0]), 3)
+            # the fleet-wide env-frames rate off the same window: the
+            # shared actor clock sums every host's ticks, so this is
+            # the live Ape-X actor/learner balance read (per-process
+            # actor/env_frames_per_s rows live in each actor's metrics
+            # stream; remote processes can't reach this registry)
+            h["actor_frames_per_sec"] = round(
+                (astep - prev[2]) / (now - prev[0]), 3)
         # health-sentinel counters (utils/health.py): learner-side guard
         # skips and rollbacks ride the shared clock; quarantine counts
         # come from this process's registry (the learner-side ingest
@@ -165,6 +206,15 @@ class FleetTopology(Topology):
             # own actor host's RestartBudget, which never reaches here
             h["local_restart_budget_remaining"] = {
                 str(s): r for s, r in budget.remaining().items()}
+        # perf plane (utils/perf.py, TPU_APEX_PERF=1): last-drained
+        # MFU/rate/watermark values of every monitor in THIS process
+        # (learner, thread-backend local actors, inference server) —
+        # fleet_top's live perf read
+        from pytorch_distributed_tpu.utils import perf
+
+        psnap = perf.status_snapshot()
+        if psnap:
+            h["perf"] = psnap
         return h
 
     def _worker_specs(self):

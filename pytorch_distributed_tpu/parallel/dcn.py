@@ -113,6 +113,10 @@ T_TICK = 6     # JSON {actor_steps, stats?, seq?}    -> T_CLOCK
 T_BYE = 7      # empty                               -> (close)
 T_PING = 8     # empty heartbeat                     -> T_CLOCK
 T_STATUS = 9   # empty -> T_STATUS JSON health snapshot (no HELLO needed)
+T_PROFILE = 10  # JSON {seconds, label?, role?} -> T_PROFILE JSON reply
+#                (sessionless like T_STATUS: triggers a bounded XLA
+#                profiler window on the learner host and reports the
+#                trace directory back — tools/fleet_top.py --profile)
 
 _MAX_FRAME = 1 << 31  # 2 GiB — far above any chunk; rejects garbage lengths
 
@@ -259,7 +263,8 @@ class DcnGateway:
                  local_actors: int = 0,
                  idle_deadline: Optional[float] = None,
                  faults: Optional[FaultInjector] = None,
-                 health: Optional[Callable[[], dict]] = None):
+                 health: Optional[Callable[[], dict]] = None,
+                 profiler: Optional[Callable[[dict], dict]] = None):
         self.param_store = param_store
         self.clock = clock
         self.actor_stats = actor_stats
@@ -273,6 +278,12 @@ class DcnGateway:
         # queue depth, restart budget, learner rate — things only the
         # learner-host wiring can see); called per STATUS request
         self._health = health
+        # on-demand profiling provider (utils/perf.run_profile_window
+        # via the owning topology): T_PROFILE requests block their own
+        # serve thread for the bounded window and reply with the trace
+        # dir; no provider wired -> error reply, never a crash
+        self._profiler = profiler
+        self.profiles_served = 0
         self._tracer = tracing.get_tracer("gateway")
         self._recorder = flight_recorder.get_recorder("gateway")
         self._born = time.monotonic()
@@ -490,11 +501,12 @@ class DcnGateway:
             with conn:
                 while not self._stop.is_set():
                     ftype, payload = _recv_frame(conn)
-                    if ftype != T_STATUS:
-                        # STATUS probes are outside the fault plane: a
-                        # monitor polling the gateway must neither shift a
-                        # deterministic drill's frame schedule nor absorb
-                        # a fault meant for session traffic
+                    if ftype not in (T_STATUS, T_PROFILE):
+                        # STATUS/PROFILE probes are outside the fault
+                        # plane: a monitor polling the gateway must
+                        # neither shift a deterministic drill's frame
+                        # schedule nor absorb a fault meant for session
+                        # traffic
                         payload = self._faults.frame(payload)
                     if slot is not None:
                         # plain GIL-atomic write: heartbeat-age reads in
@@ -508,6 +520,29 @@ class DcnGateway:
                         self.status_served += 1
                         _send_frame(conn, T_STATUS, json.dumps(
                             self.status_snapshot()).encode())
+                    elif ftype == T_PROFILE:
+                        # on-demand profiling, sessionless like STATUS.
+                        # Blocking THIS serve thread for the bounded
+                        # window is free concurrency-wise (one thread
+                        # per connection); concurrent requests are
+                        # refused by the provider's one-window lock.
+                        msg = self._json(payload) if payload else {}
+                        if self._profiler is None:
+                            reply = {"error": "no profiler wired on "
+                                              "this gateway"}
+                        else:
+                            try:
+                                reply = self._profiler(msg) or {}
+                            except Exception as e:  # noqa: BLE001
+                                reply = {"error":
+                                         f"profiler failed: {e!r}"}
+                        self.profiles_served += 1
+                        self._recorder.record(
+                            "profile-served",
+                            ok=("error" not in reply),
+                            seconds=msg.get("seconds"))
+                        _send_frame(conn, T_PROFILE,
+                                    json.dumps(reply).encode())
                     elif ftype == T_EXP:
                         try:
                             items = decode_chunk(payload)
@@ -692,6 +727,46 @@ def fetch_status(address: Tuple[str, int], timeout: float = 5.0) -> dict:
             return json.loads(payload.decode())
         except (ValueError, UnicodeDecodeError) as e:
             raise ConnectionError(f"undecodable STATUS reply: {e}")
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def fetch_profile(address: Tuple[str, int], seconds: float = 3.0,
+                  label: Optional[str] = None, role: str = "learner",
+                  timeout: Optional[float] = None) -> dict:
+    """One T_PROFILE round-trip: trigger a bounded XLA profiler window
+    on the learner host and return the reply ({"trace_dir", "seconds"}
+    on success, {"error": ...} otherwise).  Sessionless like
+    ``fetch_status`` — no HELLO, no slot claim — and sits OUTSIDE the
+    fault-injection plane, so profiling a drilled fleet never shifts
+    the drill schedule.  The reply wait covers the window plus generous
+    slack: the process's FIRST-ever profiler session pays a one-time
+    init that can exceed a minute on a saturated small host
+    (utils/perf.prewarm_profiler amortizes it at fleet startup when
+    the perf plane is enabled, but a bare fleet stays cold until the
+    first request).  The server clamps ``seconds``
+    (PerfParams.profile_window_max), so a typo'd duration errs on the
+    reply arriving early, not never."""
+    if timeout is None:
+        timeout = float(seconds) + 180.0
+    sock = socket.create_connection(address, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        msg: Dict[str, Any] = {"seconds": float(seconds), "role": role}
+        if label is not None:
+            msg["label"] = str(label)
+        _send_frame(sock, T_PROFILE, json.dumps(msg).encode())
+        rtype, payload = _recv_frame(sock)
+        if rtype != T_PROFILE:
+            raise ConnectionError(
+                f"expected T_PROFILE reply, got frame type {rtype}")
+        try:
+            return json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ConnectionError(f"undecodable PROFILE reply: {e}")
     finally:
         try:
             sock.close()

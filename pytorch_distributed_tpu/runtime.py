@@ -135,10 +135,17 @@ class Topology:
         # publishes liveness-progress marks on a shared board riding the
         # clock's spawn pickle; the monitor SIGKILLs workers whose marks
         # go stale past hang_deadline (utils/supervision.ProgressBoard).
-        from pytorch_distributed_tpu.utils import health
+        from pytorch_distributed_tpu.utils import health, perf
         from pytorch_distributed_tpu.utils.supervision import ProgressBoard
 
         self.health = health.resolve(opt.health_params)
+        # perf plane knobs resolved once for the topology; exported to
+        # the environment so spawn children (and tools THEY fork)
+        # resolve the same plane even when it was enabled
+        # programmatically rather than by TPU_APEX_PERF=1
+        self.perf = perf.resolve(opt.perf_params)
+        if self.perf.enabled:
+            perf.export_env(self.perf)
         labels = ["learner", "evaluator-0"] + [
             f"actor-{i}" for i in range(opt.num_actors)]
         self.progress_board = ProgressBoard(labels)

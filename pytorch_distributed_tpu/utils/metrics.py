@@ -142,6 +142,54 @@ class MetricsWriter:
             self._tb.close()
 
 
+class ScalarsTail:
+    """Incremental reader of a run dir's ``scalars.jsonl`` for refresh
+    loops (tools/fleet_top.py ``--metrics``): remembers the byte offset
+    of the last fully-terminated line, so each ``poll()`` costs O(new
+    rows) instead of O(run) — a long run's metrics file grows without
+    bound and a full ``read_scalars`` per refresh turns the monitor
+    itself into the I/O hog.
+
+    Torn-tail handling follows read_scalars' philosophy with one
+    refinement the offset makes possible: a trailing line WITHOUT a
+    newline is not consumed at all (the writer may still be mid-append
+    — next poll re-reads it complete), while a newline-terminated line
+    that still fails to decode (a SIGKILL-torn line mid-file) is
+    skipped for good.  A file that shrank (rotation, a fresh run
+    reusing the dir) resets the cursor to the start."""
+
+    def __init__(self, log_dir: str):
+        self.path = os.path.join(log_dir, "scalars.jsonl")
+        self._offset = 0
+
+    def poll(self) -> List[dict]:
+        """All rows appended since the previous poll."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size < self._offset:
+                    self._offset = 0  # truncated/rotated: start over
+                f.seek(self._offset)
+                data = f.read()
+        except OSError:
+            return []
+        end = data.rfind(b"\n")
+        if end < 0:
+            return []  # only an unterminated tail so far — wait
+        self._offset += end + 1
+        out = []
+        for line in data[:end + 1].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line.decode()))
+            except (ValueError, UnicodeDecodeError):
+                continue  # torn mid-file line (kill); the rest is good
+        return out
+
+
 def read_scalars(log_dir: str) -> List[dict]:
     """Load all JSONL records from a run dir (tests/bench/tools use this).
     A SIGKILL mid-write leaves a torn trailing line — skip undecodable

@@ -1,0 +1,616 @@
+"""Performance observability plane: live MFU, throughput attribution,
+device-memory watermarks, retrace + transfer auditing, on-demand
+profiling windows.
+
+Until this module, every performance number lived in offline artifacts —
+``bench.py`` one-line JSONs and ``tools/mfu_probe.py`` blobs — so the
+questions the ROADMAP's next levers hinge on ("is the learner's MFU
+moving?", "is the fleet actor-bound right now?") could only be answered
+by stopping the fleet and re-benching.  Podracer (Hessel et al. 2021)
+treats continuous device-utilization accounting as part of the training
+loop itself, and Ape-X tunes its actor/learner balance off live
+throughput ratios; this module gives the fleet the same continuously
+exported signals:
+
+- **FLOPs capture** (``flops_of_compiled``): the XLA ``cost_analysis()``
+  extraction previously duplicated in ``bench.py`` (micro + families)
+  and ``mfu_probe.py`` lives here once.  A ``PerfMonitor`` captures the
+  fused learner program's per-update FLOPs at compile time, so MFU is
+  one multiplication per stats window forever after — no re-bench.
+- **Live rates** (``PerfMonitor``): each role counts its work units
+  (learner updates, actor env frames) with one integer add on the hot
+  path; the drain on the role's normal metrics cadence turns them into
+  ``learner/updates_per_s`` / ``learner/mfu`` /
+  ``actor/env_frames_per_s`` scalar rows plus whatever gauges the role
+  sets (replay ratio, ingest-queue utilization).
+- **Memory watermarks**: device ``live``/``peak`` bytes from
+  ``device.memory_stats()`` where the backend reports them (TPU), host
+  RSS current/peak everywhere — an OOM that is still ten minutes away
+  is a dashboard read, not a post-mortem.
+- **Retrace detector** (``RetraceDetector``): registered hot-path jit
+  programs are expected to compile during warmup and NEVER again; any
+  cache growth after the warmup mark is counted, named, and exported —
+  a recompile on the hot path is a silent throughput cliff (the
+  jit-cache no-retrace smoke in tests/test_actor_pipeline.py pins one
+  program at one point in time; this watches all of them, live).
+- **Transfer audit** (``TransferAudit``): opt-in
+  ``jax.transfer_guard``-based attribution of IMPLICIT host<->device
+  transfers on paths that must be transfer-free (the fused learner
+  dispatch: state, ring and keys are all device-resident).  A flagged
+  call is attributed to its python call site and retried with
+  transfers allowed, so the audit observes without killing the run.
+- **On-demand profile windows** (``run_profile_window``): a bounded
+  ``utils/profiling.trace`` capture for the DCN gateway's sessionless
+  ``T_PROFILE`` verb (parallel/dcn.py), so ``fleet_top --profile``
+  pulls a real XLA trace off a RUNNING fleet without restarts.
+
+Per-process registry (``get_monitor``) mirrors utils/tracing.py: one
+monitor per role name, and ``status_snapshot()`` feeds the last drained
+values into the gateway's T_STATUS health plane so ``fleet_top`` shows
+them live.  Knobs live in config.PerfParams, env-overridable as
+``TPU_APEX_PERF_<FIELD>`` (bare ``TPU_APEX_PERF=1`` = ``enabled``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# peak FLOP/s + cost-analysis FLOPs extraction (shared with bench.py and
+# tools/mfu_probe.py — previously three inline copies)
+# ---------------------------------------------------------------------------
+
+# Peak dense bf16 FLOP/s per chip by device_kind, for the MFU estimate.
+# Public figures; unknown kinds report achieved FLOP/s with mfu omitted.
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def peak_flops_of(device) -> Optional[float]:
+    """Peak dense FLOP/s for a jax device, None when the kind is not in
+    the table (CPU, future generations)."""
+    kind = getattr(device, "device_kind", "") or ""
+    for name, peak in PEAK_FLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return peak
+    return None
+
+
+def flops_of_compiled(compiled) -> Optional[float]:
+    """Per-call FLOPs off an AOT-compiled executable's XLA cost
+    analysis.  XLA counts a scan/while body ONCE (verified in bench.py
+    micro across K=1/8/64), so for a fused multi-update program the
+    figure is per-UPDATE, not per-dispatch.  Best-effort: backends
+    without cost analysis return None."""
+    try:
+        cost = compiled.cost_analysis()
+        c = cost[0] if isinstance(cost, (list, tuple)) else cost
+        f = (c or {}).get("flops")
+        if f and f > 0:
+            return float(f)
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        pass
+    return None
+
+
+# ---------------------------------------------------------------------------
+# knob resolution (config.PerfParams + TPU_APEX_PERF_* env overrides)
+# ---------------------------------------------------------------------------
+
+_ENV_PREFIX = "TPU_APEX_PERF_"
+
+
+def resolve(pp=None):
+    """Apply ``TPU_APEX_PERF_<FIELD>`` env overrides to a PerfParams
+    (config.py), plus the bare ``TPU_APEX_PERF`` shorthand for
+    ``enabled`` — same override-by-env contract as health.resolve, so a
+    drive can flip the plane on without threading knobs through every
+    constructor.  Returns a NEW instance; the input is never mutated
+    (Options rides spawn pickles)."""
+    from pytorch_distributed_tpu.config import PerfParams
+
+    if pp is None:
+        pp = PerfParams()
+    changes: Dict[str, Any] = {}
+    raw_on = os.environ.get("TPU_APEX_PERF")
+    if raw_on is not None:
+        changes["enabled"] = raw_on.strip().lower() not in (
+            "0", "false", "off", "no", "")
+    for f in dataclasses.fields(pp):
+        raw = os.environ.get(_ENV_PREFIX + f.name.upper())
+        if raw is None:
+            continue
+        cur = getattr(pp, f.name)
+        if isinstance(cur, bool):
+            changes[f.name] = raw.strip().lower() not in (
+                "0", "false", "off", "no", "")
+        elif isinstance(cur, int) and not isinstance(cur, bool):
+            changes[f.name] = int(float(raw))
+        else:
+            changes[f.name] = float(raw)
+    return dataclasses.replace(pp, **changes) if changes else pp
+
+
+def export_env(pp) -> None:
+    """Export a RESOLVED PerfParams into the environment so spawn
+    children (and their children — tools forked from workers) resolve
+    the same plane even when it was enabled programmatically rather
+    than by env.  setdefault: an operator's explicit env always
+    wins."""
+    if pp.enabled:
+        os.environ.setdefault("TPU_APEX_PERF", "1")
+    for f in dataclasses.fields(pp):
+        val = getattr(pp, f.name)
+        if val != f.default:
+            os.environ.setdefault(_ENV_PREFIX + f.name.upper(),
+                                  ("1" if val is True else
+                                   "0" if val is False else str(val)))
+
+
+# ---------------------------------------------------------------------------
+# retrace detector
+# ---------------------------------------------------------------------------
+
+class RetraceDetector:
+    """Counts jit cache misses per registered hot-path program and flags
+    growth after warmup.
+
+    Registration takes a zero-arg callable returning the program's
+    current jit cache size (``jitted._cache_size`` — the same surface
+    the actor engines already expose via ``jit_cache_size``); callables
+    returning None (server-side jits, plain functions) are skipped per
+    check, not rejected, so callers can register unconditionally.  The
+    FIRST ``check()`` is the warmup mark: everything compiled up to it
+    is expected; any growth seen by a later check is a retrace — a
+    shape/dtype leak paying compile latency on the hot path."""
+
+    def __init__(self):
+        self._fns: Dict[str, Callable[[], Optional[int]]] = {}
+        self._warm: Dict[str, int] = {}
+        self._warmed = False
+        self.retraces = 0                 # post-warmup recompiles, total
+        self.fired: Dict[str, int] = {}   # per-program retrace counts
+
+    def register(self, name: str,
+                 size_fn: Optional[Callable[[], Optional[int]]]) -> None:
+        if size_fn is not None:
+            self._fns[name] = size_fn
+
+    def _sizes(self) -> Dict[str, int]:
+        out = {}
+        for name, fn in self._fns.items():
+            try:
+                size = fn()
+            except Exception:  # noqa: BLE001 - a dead fn must not kill perf
+                size = None
+            if size is not None:
+                out[name] = int(size)
+        return out
+
+    def mark_warm(self) -> None:
+        """Snapshot current cache sizes as the expected-compile set."""
+        self._warm = self._sizes()
+        self._warmed = True
+
+    def check(self) -> List[str]:
+        """Names of programs that recompiled since the last check.  The
+        first call marks warmup instead of firing (startup compiles are
+        legitimate); each recompile is counted once (the high-water
+        advances)."""
+        if not self._warmed:
+            self.mark_warm()
+            return []
+        fired = []
+        for name, size in self._sizes().items():
+            prev = self._warm.get(name)
+            if prev is None:
+                self._warm[name] = size  # late registration: new warmup
+                continue
+            if size > prev:
+                grew = size - prev
+                self.retraces += grew
+                self.fired[name] = self.fired.get(name, 0) + grew
+                self._warm[name] = size
+                fired.append(name)
+        return fired
+
+
+# ---------------------------------------------------------------------------
+# transfer audit
+# ---------------------------------------------------------------------------
+
+class TransferAudit:
+    """Attribute IMPLICIT host<->device transfers on a supposedly
+    transfer-free path to their call sites.
+
+    ``run(fn, *args)`` executes ``fn`` under ``jax.transfer_guard
+    ("disallow")`` — which trips on implicit transfers only; explicit
+    ``device_put``/``device_get`` are intended by definition and pass.
+    On a trip the XLA error's traceback is walked to the innermost
+    frame OUTSIDE jax itself (the call site that smuggled a host array
+    onto the device path), the site is counted, and the call is retried
+    with transfers allowed so the run continues.  The guard raises
+    while STAGING the offending argument — before the program executes
+    — so the retry is the only execution of a flagged jit dispatch."""
+
+    def __init__(self):
+        self.total = 0
+        self.sites: Dict[str, int] = {}
+        self.last_error: Optional[str] = None
+
+    @staticmethod
+    def _is_transfer_error(e: BaseException) -> bool:
+        msg = str(e).lower()
+        return "transfer" in msg and "disallow" in msg
+
+    @staticmethod
+    def _frame_site(frames) -> Optional[str]:
+        site = None
+        for fr in frames:
+            path = fr.filename.replace(os.sep, "/")
+            if "/jax/" in path or "/jaxlib/" in path \
+                    or path.endswith("utils/perf.py"):
+                continue
+            site = f"{fr.filename}:{fr.lineno} ({fr.name})"
+        return site
+
+    @classmethod
+    def _attribute(cls, e: BaseException) -> str:
+        """Innermost python frame outside jax/jaxlib that owns the
+        stray host array: from the error's traceback when the transfer
+        staged deep inside the audited callable, else from the caller
+        stack (the audited callable IS the jit dispatch — the guard
+        trips while staging its arguments, so the interesting frame is
+        the dispatch site above us)."""
+        site = cls._frame_site(traceback.extract_tb(e.__traceback__))
+        if site is None:
+            site = cls._frame_site(traceback.extract_stack())
+        return site or "<unattributed>"
+
+    def run(self, fn, *args, **kwargs):
+        import jax
+
+        try:
+            with jax.transfer_guard("disallow"):
+                return fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - only transfer trips handled
+            if not self._is_transfer_error(e):
+                raise
+            site = self._attribute(e)
+            first = site not in self.sites
+            self.total += 1
+            self.sites[site] = self.sites.get(site, 0) + 1
+            self.last_error = str(e).splitlines()[0][:300]
+            if first:  # one warning per site, not per tick
+                print(f"[perf] transfer audit: implicit transfer on an "
+                      f"audited hot path at {site}: {self.last_error}",
+                      flush=True)
+            with jax.transfer_guard("allow"):
+                return fn(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# host/device memory watermarks
+# ---------------------------------------------------------------------------
+
+def host_rss_bytes() -> Optional[int]:
+    """Current resident set size of this process (Linux /proc)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def host_peak_rss_bytes() -> Optional[int]:
+    """Lifetime peak RSS (getrusage; ru_maxrss is KiB on Linux)."""
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # noqa: BLE001 - exotic hosts
+        return None
+
+
+def device_memory_watermarks() -> Dict[str, float]:
+    """``live``/``peak`` bytes from the first device's
+    ``memory_stats()`` — present on TPU backends, None on CPU (where
+    the host RSS rows carry the watermark instead)."""
+    out: Dict[str, float] = {}
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 - no backend yet / no stats
+        return out
+    if not stats:
+        return out
+    live = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    if live is not None:
+        out["device_live_bytes"] = float(live)
+    if peak is not None:
+        out["device_peak_bytes"] = float(peak)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the monitor
+# ---------------------------------------------------------------------------
+
+class PerfMonitor:
+    """Per-role performance accounting.
+
+    Hot-path surface is two integer adds (``note_updates`` /
+    ``note_frames``) that early-out when the plane is disabled; all
+    derivation — window rates, MFU, watermarks, retrace checks — runs
+    in ``drain()`` on the role's normal metrics cadence and returns a
+    flat ``{tag: value}`` dict for the role's MetricsWriter.  The last
+    drained dict is kept for the registry's ``status_snapshot`` so the
+    T_STATUS health plane serves fresh values without re-deriving."""
+
+    def __init__(self, name: str, params=None, prefix: Optional[str] = None):
+        self.name = name
+        # "actor-3" -> tag prefix "actor": tags stay fleet-comparable,
+        # rows are process-attributed by the writer's role stamp
+        self.prefix = prefix if prefix is not None else name.split("-")[0]
+        self.params = resolve(params)
+        self.enabled = self.params.enabled
+        self.flops_per_update: Optional[float] = None
+        self._peak: Optional[float] = None
+        self._peak_resolved = False
+        self.retraces = RetraceDetector()
+        self.audit = (TransferAudit()
+                      if self.enabled and self.params.transfer_audit
+                      else None)
+        self._updates = 0
+        self._frames = 0
+        self._gauges: Dict[str, float] = {}
+        self._anchor: Optional[tuple] = None  # (mono, updates, frames)
+        self._flops_reported = False
+        self.last: Dict[str, float] = {}
+
+    # -- compile-time capture ------------------------------------------------
+
+    def capture_flops(self, lower_thunk: Callable[[], Any]
+                      ) -> Optional[float]:
+        """AOT-compile the hot program once (``lower_thunk`` returns a
+        ``Lowered``) and keep its per-update FLOPs.  Best-effort: a
+        backend that cannot lower/compile/cost-analyse leaves MFU off
+        rather than failing the role."""
+        if not self.enabled:
+            return None
+        try:
+            self.flops_per_update = flops_of_compiled(
+                lower_thunk().compile())
+        except Exception as e:  # noqa: BLE001
+            print(f"[perf] {self.name}: flops capture failed ({e!r}); "
+                  f"mfu reporting disabled", flush=True)
+            self.flops_per_update = None
+        return self.flops_per_update
+
+    def register_jit(self, name: str,
+                     size_fn: Optional[Callable[[], Optional[int]]]) -> None:
+        if self.enabled and self.params.retrace_detector:
+            self.retraces.register(name, size_fn)
+
+    # -- hot path ------------------------------------------------------------
+
+    def note_updates(self, n: int) -> None:
+        if self.enabled:
+            self._updates += n
+
+    def note_frames(self, n: int) -> None:
+        if self.enabled:
+            self._frames += n
+
+    def set_gauge(self, tag: str, value: float) -> None:
+        if self.enabled:
+            self._gauges[tag] = float(value)
+
+    # -- cadence -------------------------------------------------------------
+
+    def _peak_flops(self) -> Optional[float]:
+        if not self._peak_resolved:
+            self._peak_resolved = True
+            if self.params.peak_flops > 0:
+                self._peak = float(self.params.peak_flops)
+            else:
+                try:
+                    import jax
+
+                    self._peak = peak_flops_of(jax.devices()[0])
+                except Exception:  # noqa: BLE001
+                    self._peak = None
+        return self._peak
+
+    def drain(self, step: int = 0, now: Optional[float] = None
+              ) -> Dict[str, float]:
+        """Window rates + derived metrics since the previous drain, as
+        ``{tag: value}``.  The first call anchors the window (and the
+        retrace warmup) and returns only non-rate rows."""
+        if not self.enabled:
+            return {}
+        if now is None:
+            now = time.monotonic()
+        out: Dict[str, float] = {}
+        anchor = self._anchor
+        self._anchor = (now, self._updates, self._frames)
+        if anchor is not None and now > anchor[0]:
+            dt = now - anchor[0]
+            d_up = self._updates - anchor[1]
+            d_fr = self._frames - anchor[2]
+            if self._updates or d_up:
+                ups = d_up / dt
+                out[f"{self.prefix}/updates_per_s"] = ups
+                if self.flops_per_update:
+                    achieved = ups * self.flops_per_update
+                    out[f"{self.prefix}/achieved_flops_per_s"] = achieved
+                    peak = self._peak_flops()
+                    if peak:
+                        out[f"{self.prefix}/mfu"] = achieved / peak
+            if self._frames or d_fr:
+                out[f"{self.prefix}/env_frames_per_s"] = d_fr / dt
+        if self.flops_per_update and not self._flops_reported:
+            self._flops_reported = True
+            out[f"{self.prefix}/flops_per_update"] = self.flops_per_update
+        out.update(self._gauges)
+        if self.params.memory_watermarks:
+            rss = host_rss_bytes()
+            if rss is not None:
+                out[f"perf/{self.prefix}/rss_bytes"] = float(rss)
+            peak_rss = host_peak_rss_bytes()
+            if peak_rss is not None:
+                out[f"perf/{self.prefix}/rss_peak_bytes"] = float(peak_rss)
+            for k, v in device_memory_watermarks().items():
+                out[f"perf/{self.prefix}/{k}"] = v
+        if self.params.retrace_detector and self.retraces._fns \
+                and (self._updates or self._frames):
+            # gated on work having happened: the warmup mark must land
+            # AFTER the first dispatches compiled (an anchor-only drain
+            # before the loop would otherwise read them as retraces)
+            fired = self.retraces.check()
+            if fired:
+                print(f"[perf] {self.name}: post-warmup recompile of "
+                      f"{', '.join(fired)} — a shape/dtype leak is "
+                      f"paying compile latency on the hot path",
+                      flush=True)
+            out[f"perf/{self.prefix}/retraces"] = float(
+                self.retraces.retraces)
+        if self.audit is not None:
+            out[f"perf/{self.prefix}/transfers_flagged"] = float(
+                self.audit.total)
+        self.last = dict(out)
+        return out
+
+    def snapshot(self) -> Dict[str, float]:
+        """Last drained values plus cumulative counters — the read the
+        STATUS health plane serves.  No derivation, no reset: safe from
+        any thread at any rate."""
+        snap = dict(self.last)
+        snap["updates_total"] = float(self._updates)
+        snap["frames_total"] = float(self._frames)
+        if self.flops_per_update:
+            snap[f"{self.prefix}/flops_per_update"] = self.flops_per_update
+        return snap
+
+
+# ---------------------------------------------------------------------------
+# per-process registry (mirrors utils/tracing.py get_tracer)
+# ---------------------------------------------------------------------------
+
+_registry_lock = threading.Lock()
+_monitors: Dict[str, PerfMonitor] = {}
+
+
+def get_monitor(name: str, params=None,
+                prefix: Optional[str] = None) -> PerfMonitor:
+    with _registry_lock:
+        m = _monitors.get(name)
+        if m is None:
+            m = _monitors[name] = PerfMonitor(name, params=params,
+                                              prefix=prefix)
+        return m
+
+
+def status_snapshot() -> Dict[str, Dict[str, float]]:
+    """{role: snapshot} for every enabled monitor in this process that
+    has seen work — the ``perf`` block of the gateway's T_STATUS."""
+    with _registry_lock:
+        monitors = list(_monitors.values())
+    out = {}
+    for m in monitors:
+        if m.enabled and (m.last or m._updates or m._frames):
+            out[m.name] = m.snapshot()
+    return out
+
+
+def reset() -> None:
+    """Drop all registered monitors (test isolation)."""
+    with _registry_lock:
+        _monitors.clear()
+
+
+# ---------------------------------------------------------------------------
+# on-demand profile windows (the T_PROFILE provider)
+# ---------------------------------------------------------------------------
+
+_profile_lock = threading.Lock()
+_prewarmed = False
+
+
+def prewarm_profiler() -> threading.Thread:
+    """Warm the XLA profiler's one-time session init on a background
+    thread (a throwaway ~50 ms trace into a temp dir).
+
+    Measured on this image: the FIRST ``jax.profiler.start_trace`` of a
+    process pays ~20 s of lazy TSL/import work when idle — and over a
+    MINUTE when a hot dispatch loop is starving the GIL on a small
+    host; every later trace starts in milliseconds even under full
+    load.  The fleet topology calls this at startup (perf plane
+    enabled only), so the operator's first ``fleet_top --profile``
+    answers at window speed instead of minutes into a saturated run.
+    Holds the one-window lock while warming: a concurrent T_PROFILE
+    gets the explicit busy error, not a nested capture."""
+    def _warm() -> None:
+        global _prewarmed
+        import shutil
+        import tempfile
+
+        tmp = tempfile.mkdtemp(prefix="perf_profiler_warm_")
+        try:
+            run_profile_window(tmp, label="_warmup", seconds=0.05)
+            _prewarmed = True
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    t = threading.Thread(target=_warm, name="perf-profiler-warm",
+                         daemon=True)
+    t.start()
+    return t
+
+
+def run_profile_window(trace_dir: str, label: str = "tprofile",
+                       seconds: float = 3.0,
+                       max_seconds: float = 30.0) -> Dict[str, Any]:
+    """Capture one bounded XLA profiler window of THIS process's device
+    activity into ``trace_dir`` and report where it landed.
+
+    Blocks for the (clamped) window — the caller is a gateway serve
+    thread with its own connection, so blocking is free concurrency-
+    wise.  One window at a time: a second request while one is active
+    gets an error reply instead of a nested capture (utils/profiling.
+    trace would no-op a nested window anyway; the explicit error tells
+    the operator WHY there is no trace)."""
+    from pytorch_distributed_tpu.utils import profiling
+
+    try:
+        seconds = float(seconds)
+    except (TypeError, ValueError):
+        return {"error": f"bad seconds value {seconds!r}"}
+    seconds = max(0.05, min(seconds, max_seconds))
+    if not _profile_lock.acquire(blocking=False):
+        return {"error": "a profile window is already active"}
+    try:
+        with profiling.trace(str(label), log_dir=trace_dir) as path:
+            if path is None:
+                return {"error": "profiler unavailable (a trace is "
+                                 "already active in this process)"}
+            time.sleep(seconds)
+        return {"trace_dir": path, "seconds": seconds}
+    except Exception as e:  # noqa: BLE001 - report, never kill the serve
+        return {"error": f"profile capture failed: {e!r}"}
+    finally:
+        _profile_lock.release()

@@ -23,7 +23,10 @@ from __future__ import annotations
 
 import contextlib
 import os
+import re
+import threading
 import time
+import warnings
 from typing import Dict, Iterator, Optional
 
 
@@ -67,25 +70,73 @@ class StepTimer:
             out[f"{self.prefix}/time_{name}_max_ms"] = \
                 self._max.get(name, 0.0) * 1e3
             out[f"{self.prefix}/time_{name}_calls"] = float(n)
+            # the window's TOTAL: means hide call-count asymmetry, so
+            # per-phase means never sum to wall time — totals do, which
+            # is what a stacked phase-share plot needs
+            # (tools/plot_run.py --phase-breakdown)
+            out[f"{self.prefix}/time_{name}_total_ms"] = secs * 1e3
         self._acc.clear()
         self._max.clear()
         self._n.clear()
         return out
 
 
+def sanitize_label(label: str) -> str:
+    """A trace label safe to join into the trace path.  Labels arrive
+    from callers AND from the network (the DCN ``T_PROFILE`` verb
+    forwards a client-supplied label), so anything outside
+    ``[A-Za-z0-9._-]`` — path separators above all — is squashed to
+    ``-`` and leading dots are stripped; an emptied label falls back to
+    ``trace``."""
+    clean = re.sub(r"[^A-Za-z0-9._-]+", "-", str(label)).lstrip(".-")
+    return clean or "trace"
+
+
+# one profiler per process: jax.profiler.trace raises on a nested
+# start, which used to turn an inner library trace (mfu_probe inside a
+# TPU_APEX_PROFILE'd run) into a crash of the OUTER capture
+_trace_lock = threading.Lock()
+_trace_active = False
+
+
 @contextlib.contextmanager
-def trace(label: str, log_dir: Optional[str] = None) -> Iterator[None]:
+def trace(label: str, log_dir: Optional[str] = None
+          ) -> Iterator[Optional[str]]:
     """Capture an XLA profiler trace for the enclosed block when enabled.
 
     Enabled by passing ``log_dir`` or by setting ``TPU_APEX_PROFILE`` to a
-    directory; otherwise a no-op.  View with TensorBoard's profile plugin.
+    directory; otherwise a no-op.  Yields the trace directory (None when
+    disabled or when a trace is already active — a nested capture is a
+    warning + no-op, never a profiler error: the outer window keeps
+    recording and the inner caller learns from the None).  View with
+    TensorBoard's profile plugin.
     """
+    global _trace_active
     target = log_dir or os.environ.get("TPU_APEX_PROFILE")
     if not target:
-        yield
+        yield None
         return
-    import jax
+    with _trace_lock:
+        nested = _trace_active
+        if not nested:
+            _trace_active = True
+    if nested:
+        # warn + no-op OUTSIDE the lock: yielding with it held would
+        # stall the outer trace's exit behind this caller's whole body
+        # (and deadlock a doubly-nested same-thread capture)
+        warnings.warn(
+            f"profiling.trace({label!r}): a trace is already active "
+            f"in this process; nested capture skipped (the outer "
+            f"window keeps recording)", stacklevel=3)
+        yield None
+        return
+    try:
+        import jax
 
-    os.makedirs(target, exist_ok=True)
-    with jax.profiler.trace(os.path.join(target, label)):
-        yield
+        os.makedirs(target, exist_ok=True)
+        path = os.path.join(target, sanitize_label(label))
+        with jax.profiler.trace(path):
+            yield path
+    finally:
+        with _trace_lock:
+            _trace_active = False

@@ -4,17 +4,32 @@
 ``top`` for the Ape-X fleet: polls the learner host's gateway
 (parallel/dcn.py ``fetch_status`` — sessionless, no actor slot consumed)
 and renders slot states, incarnations, heartbeat ages, restart-budget
-remaining, replay fill / ingest-queue depth, and the learner step rate.
+remaining, replay fill / ingest-queue depth, the learner step rate, and
+— with ``TPU_APEX_PERF=1`` on the fleet — the live perf plane (MFU,
+updates/s, env frames/s, memory watermarks, retrace count).
 
 Usage:
     python tools/fleet_top.py HOST:PORT            # refresh loop (humans)
     python tools/fleet_top.py HOST:PORT --json     # one snapshot (CI)
     python tools/fleet_top.py HOST:PORT --interval 1
+    python tools/fleet_top.py HOST:PORT --metrics logs/<refs>
+    python tools/fleet_top.py HOST:PORT --profile learner --seconds 5
 
 One-shot ``--json`` prints the raw snapshot and exits 0 (nonzero when the
 gateway is unreachable) so orchestrators/CI can assert fleet health with
 ``fleet_top ... --json | jq``.  The refresh loop reconnects every poll,
 so it keeps reporting across the gateway restarts it exists to observe.
+
+``--metrics LOG_DIR`` overlays the newest perf/phase scalar rows from the
+run's ``scalars.jsonl`` using an INCREMENTAL tail reader
+(utils/metrics.ScalarsTail): the file is read once from the remembered
+offset per refresh, so a days-long run's metrics stream never turns the
+monitor into the I/O hog (re-reading the whole JSONL per refresh is
+O(run)).
+
+``--profile ROLE`` triggers one bounded XLA profiler window on the
+running fleet over the sessionless ``T_PROFILE`` verb and prints the
+trace directory — a real device trace without restarting anything.
 """
 
 from __future__ import annotations
@@ -24,12 +39,15 @@ import json
 import os
 import sys
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
-from pytorch_distributed_tpu.parallel.dcn import fetch_status  # noqa: E402
+from pytorch_distributed_tpu.parallel.dcn import (  # noqa: E402
+    fetch_profile, fetch_status,
+)
+from pytorch_distributed_tpu.utils.metrics import ScalarsTail  # noqa: E402
 
 
 def _fmt_age(age: Optional[float]) -> str:
@@ -40,7 +58,75 @@ def _fmt_age(age: Optional[float]) -> str:
     return f"{age / 60:.1f}m"
 
 
-def render(status: dict) -> str:
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return "?"
+
+
+# scalar tags the --metrics overlay keeps current (exact tags + the
+# watermark prefix); everything else in the JSONL stays for plot_run/TB
+_METRIC_TAGS = ("learner/mfu", "learner/updates_per_s",
+                "learner/replay_ratio", "learner/ingest_queue_util",
+                "actor/env_frames_per_s")
+
+
+def perf_line(status: dict,
+              metrics_latest: Optional[Dict[str, float]] = None
+              ) -> Optional[str]:
+    """One panel line for the perf plane: STATUS ``perf`` block (the
+    learner process's monitors) merged with --metrics overlay rows
+    (which also cover process-separated actors)."""
+    vals: Dict[str, float] = {}
+    for snap in (status.get("perf") or {}).values():
+        for k, v in snap.items():
+            if isinstance(v, (int, float)):
+                vals.setdefault(k, v)
+    for k, v in (metrics_latest or {}).items():
+        vals[k] = v  # JSONL rows are fresher for cross-process roles
+    ups = vals.get("learner/updates_per_s",
+                   status.get("learner_steps_per_sec"))
+    fps = vals.get("actor/env_frames_per_s",
+                   status.get("actor_frames_per_sec"))
+    mfu = vals.get("learner/mfu")
+    bits = []
+    if mfu is not None:
+        bits.append(f"mfu {mfu:.4f}")
+    if ups is not None:
+        bits.append(f"learner {ups:.1f} up/s")
+    if fps is not None:
+        bits.append(f"actors {fps:.1f} frames/s")
+    rr = vals.get("learner/replay_ratio")
+    if rr is not None:
+        bits.append(f"replay-ratio {rr:.2f}")
+    qu = vals.get("learner/ingest_queue_util")
+    if qu is not None:
+        bits.append(f"ingest {qu:.0%}")
+    live = vals.get("perf/learner/device_live_bytes")
+    peak = vals.get("perf/learner/device_peak_bytes")
+    if live is None:
+        live, peak = (vals.get("perf/learner/rss_bytes"),
+                      vals.get("perf/learner/rss_peak_bytes"))
+    if live is not None:
+        bits.append(f"mem {_fmt_bytes(live)}"
+                    + (f" (peak {_fmt_bytes(peak)})" if peak is not None
+                       else ""))
+    retr = vals.get("perf/learner/retraces", 0) + vals.get(
+        "perf/actor/retraces", 0)
+    if retr:
+        bits.append(f"RETRACES {int(retr)}")
+    tf = vals.get("perf/learner/transfers_flagged")
+    if tf:
+        bits.append(f"TRANSFERS {int(tf)}")
+    return "  perf: " + " · ".join(bits) if bits else None
+
+
+def render(status: dict,
+           metrics_latest: Optional[Dict[str, float]] = None) -> str:
     """One snapshot as a plain-text panel (no curses: works in any
     terminal, and the --once output is diffable in CI logs)."""
     lines: List[str] = []
@@ -68,6 +154,9 @@ def render(status: dict) -> str:
                  f" · chunks {status.get('chunks_in', 0)}"
                  f" · fenced {status.get('fenced', 0)}")
     lines.append("  " + "   ".join(parts))
+    pline = perf_line(status, metrics_latest)
+    if pline:
+        lines.append(pline)
     # health sentinel (utils/health.py): guard skips / rollbacks / hang
     # kills from the learner host, quarantine counts split by boundary —
     # the gateway's per-slot counts name WHICH remote actor is poisoning
@@ -106,6 +195,17 @@ def render(status: dict) -> str:
     return "\n".join(lines)
 
 
+def _absorb_rows(latest: Dict[str, float], rows: List[dict]) -> None:
+    """Keep the newest value per tag of interest (perf plane scalars +
+    memory watermarks); non-scalar rows (histograms, spans) skipped."""
+    for r in rows:
+        tag = r.get("tag")
+        if not tag or "value" not in r:
+            continue
+        if tag in _METRIC_TAGS or tag.startswith("perf/"):
+            latest[tag] = r["value"]
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools/fleet_top.py",
@@ -120,12 +220,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="refresh period, seconds")
     ap.add_argument("--timeout", type=float, default=5.0,
                     help="per-probe connect/reply timeout, seconds")
+    ap.add_argument("--metrics", type=str, default=None, metavar="LOG_DIR",
+                    help="overlay the newest perf scalars from this run "
+                         "dir's scalars.jsonl (incremental tail reads — "
+                         "O(new rows) per refresh, not O(run))")
+    ap.add_argument("--profile", type=str, default=None, metavar="ROLE",
+                    const="learner", nargs="?",
+                    help="trigger one bounded XLA profiler window on the "
+                         "running fleet (T_PROFILE verb) and print the "
+                         "trace directory; ROLE defaults to learner — "
+                         "the only role the gateway process can trace")
+    ap.add_argument("--seconds", type=float, default=3.0,
+                    help="profile window length for --profile "
+                         "(server-clamped by PerfParams."
+                         "profile_window_max)")
+    ap.add_argument("--label", type=str, default=None,
+                    help="trace label for --profile (sanitized "
+                         "server-side)")
     args = ap.parse_args(argv)
 
     host, _, port = args.gateway.rpartition(":")
     if not host or not port.isdigit():
         ap.error(f"--gateway must be host:port (got {args.gateway!r})")
     addr = (host, int(port))
+
+    if args.profile is not None:
+        # the one-window lock makes "busy" a TRANSIENT reply (another
+        # probe's window, or the startup prewarm still crawling through
+        # the profiler's one-time init on a saturated host) — retry it
+        # for the operator instead of bailing
+        deadline = time.monotonic() + args.seconds + 180.0
+        while True:
+            try:
+                reply = fetch_profile(addr, seconds=args.seconds,
+                                      label=args.label,
+                                      role=args.profile)
+            except (ConnectionError, OSError) as e:
+                print(f"fleet_top: gateway {args.gateway} unreachable: "
+                      f"{e}", file=sys.stderr)
+                return 1
+            err = reply.get("error", "")
+            transient = ("already active" in err
+                         or "unavailable" in err)
+            if not transient or time.monotonic() > deadline:
+                break
+            print(f"fleet_top: {err}; retrying...", file=sys.stderr)
+            time.sleep(2.0)
+        print(json.dumps(reply, indent=2, sort_keys=True))
+        if "error" in reply:
+            print(f"fleet_top: profile failed: {reply['error']}",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+    tail = ScalarsTail(args.metrics) if args.metrics else None
+    latest: Dict[str, float] = {}
 
     if args.json or args.once:
         try:
@@ -134,14 +283,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"fleet_top: gateway {args.gateway} unreachable: {e}",
                   file=sys.stderr)
             return 1
+        if tail is not None:
+            _absorb_rows(latest, tail.poll())
+            if args.json and latest:
+                status = dict(status, metrics_latest=latest)
         print(json.dumps(status, indent=2, sort_keys=True) if args.json
-              else render(status))
+              else render(status, latest))
         return 0
 
     try:
         while True:
+            if tail is not None:
+                _absorb_rows(latest, tail.poll())
             try:
-                panel = render(fetch_status(addr, timeout=args.timeout))
+                panel = render(fetch_status(addr, timeout=args.timeout),
+                               latest)
             except (ConnectionError, OSError) as e:
                 panel = (f"gateway {args.gateway} unreachable: {e}\n"
                          f"  (retrying every {args.interval:g}s — a "

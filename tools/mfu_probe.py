@@ -81,15 +81,11 @@ def measure(fused, state, ring, K: int, windows: int = 5,
         return jax.random.split(sub, K)
 
     compiled = fused.lower(state, ring.state, keymat()).compile()
-    flops = None
-    try:
-        cost = compiled.cost_analysis()
-        c = cost[0] if isinstance(cost, (list, tuple)) else cost
-        f = (c or {}).get("flops")
-        if f and f > 0:
-            flops = float(f)
-    except Exception:  # noqa: BLE001
-        pass
+    # shared with bench.py and the live perf plane (utils/perf.py) —
+    # one extraction, three consumers
+    from pytorch_distributed_tpu.utils.perf import flops_of_compiled
+
+    flops = flops_of_compiled(compiled)
     for _ in range(6):
         state, m = compiled(state, ring.state, keymat())
     float(jax.device_get(m["learner/critic_loss"]))
@@ -184,9 +180,9 @@ def main() -> None:
 
     enable_compile_cache()
     dev = jax.devices()[0]
-    from bench import _peak_flops
+    from pytorch_distributed_tpu.utils.perf import peak_flops_of
 
-    peak = _peak_flops(dev) or float("nan")
+    peak = peak_flops_of(dev) or float("nan")
     out = {"device_kind": getattr(dev, "device_kind", "?")}
 
     # production point: B=128, K=32, bf16

@@ -8,16 +8,26 @@ requested tags, one panel per tag, sharing the x-axis.
 Usage:
     python tools/plot_run.py <log_dir> [--tags evaluator/avg_reward ...] \
         [--x wall|step] [--out run.png]
+    python tools/plot_run.py <log_dir> --phase-breakdown actor
 
 Defaults: the three headline tags, x = wall-clock minutes,
 out = <log_dir>/run.png.
+
+``--phase-breakdown ROLE`` renders a stacked per-phase wall-time plot
+from the role's ``<role>/time_<phase>_total_ms`` rows (StepTimer drain
+totals): each drain window's phase TOTALS stack to the role's busy time
+in that window, so "where does the tick go" is one picture — means
+can't stack (they hide call-count asymmetry), totals can, which is why
+StepTimer exports them.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
+from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
@@ -29,6 +39,10 @@ DEFAULT_TAGS = ("evaluator/avg_reward", "learner/critic_loss",
 
 # thin marks, recessive grid, neutral ink; blue = categorical slot 1
 INK, MUTED, GRID, BLUE = "#1a1a1a", "#6b6b6b", "#e5e5e5", "#2a78d6"
+# categorical fills for the stacked phase plot (same muted family as
+# the line color; order = stack order)
+PHASE_COLORS = ("#2a78d6", "#d6762a", "#3aa76d", "#a04bd1", "#c9365a",
+                "#7a7a7a", "#b8a12e", "#2ab5c9")
 
 
 def load_series(log_dir: str, tags):
@@ -47,13 +61,103 @@ def load_series(log_dir: str, tags):
     return series, t0
 
 
+def load_phase_windows(log_dir: str, role: str):
+    """Per-drain-window phase totals for ONE process:
+    ``(walls, {phase: [total_ms per window]})``, windows keyed by the
+    row wall-clock (one StepTimer drain writes all its phases with one
+    wall stamp).  ``role`` may be a process role stamp (``actor-0``)
+    or a bare tag prefix (``actor``) — but StepTimer tags share the
+    prefix across all of a role's processes, so when several processes
+    contributed rows, the bare prefix is ambiguous (their windows
+    would interleave into a meaningless sawtooth) and the caller must
+    name one."""
+    prefix = role.split("-")[0]
+    pat = re.compile(rf"^{re.escape(prefix)}/time_(\w+?)_total_ms$")
+    matched = [(r, m) for r in read_scalars(log_dir) if "value" in r
+               for m in (pat.match(r.get("tag", "")),) if m]
+    roles = sorted({r.get("role", prefix) for r, _m in matched})
+    if role in roles:
+        matched = [(r, m) for r, m in matched
+                   if r.get("role", prefix) == role]
+    elif len(roles) > 1:
+        raise SystemExit(
+            f"--phase-breakdown {role!r} matches rows from "
+            f"{len(roles)} processes ({', '.join(roles)}); their drain "
+            f"windows don't align — pass one exact role")
+    windows = defaultdict(dict)  # wall -> {phase: ms}
+    for r, m in matched:
+        windows[r["wall"]][m.group(1)] = r["value"]
+    walls = sorted(windows)
+    phases = sorted({p for w in windows.values() for p in w},
+                    key=lambda p: -sum(w.get(p, 0.0)
+                                       for w in windows.values()))
+    return walls, {p: [windows[w].get(p, 0.0) for w in walls]
+                   for p in phases}
+
+
+def _style_axis(ax):
+    ax.set_facecolor("white")
+    ax.grid(True, color=GRID, lw=0.7, zorder=0)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(GRID)
+    ax.tick_params(colors=MUTED, labelsize=8)
+
+
+def plot_phase_breakdown(log_dir: str, role: str, out: str) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    walls, phases = load_phase_windows(log_dir, role)
+    if len(walls) < 2 or not phases:
+        raise SystemExit(
+            f"no {role}/time_*_total_ms rows (or <2 drain windows) in "
+            f"{log_dir}/scalars.jsonl — is the role's StepTimer "
+            f"draining?")
+    t0 = walls[0]
+    xs = [(w - t0) / 60.0 for w in walls]
+    fig, ax = plt.subplots(figsize=(7.2, 3.2), dpi=150)
+    fig.patch.set_facecolor("white")
+    ax.stackplot(xs, *(phases[p] for p in phases),
+                 labels=list(phases),
+                 colors=[PHASE_COLORS[i % len(PHASE_COLORS)]
+                         for i in range(len(phases))],
+                 alpha=0.85, lw=0.0, zorder=3)
+    _style_axis(ax)
+    ax.set_title(f"{role}: per-phase wall time per drain window "
+                 f"(StepTimer totals)", fontsize=9.5, color=INK,
+                 loc="left")
+    ax.set_xlabel("wall-clock (minutes)", fontsize=9, color=MUTED)
+    ax.set_ylabel("ms per window", fontsize=9, color=MUTED)
+    ax.legend(loc="upper right", fontsize=7, frameon=False,
+              labelcolor=INK)
+    fig.tight_layout()
+    fig.savefig(out, bbox_inches="tight")
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("log_dir")
     ap.add_argument("--tags", nargs="+", default=list(DEFAULT_TAGS))
     ap.add_argument("--x", choices=("wall", "step"), default="wall")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--phase-breakdown", type=str, default=None,
+                    metavar="ROLE",
+                    help="stacked per-phase wall-time plot from the "
+                         "role's StepTimer *_total_ms rows (e.g. actor, "
+                         "learner) instead of scalar panels")
     args = ap.parse_args()
+
+    if args.phase_breakdown:
+        out = args.out or os.path.join(
+            args.log_dir, f"phases_{args.phase_breakdown}.png")
+        print(plot_phase_breakdown(args.log_dir, args.phase_breakdown,
+                                   out))
+        return
 
     import matplotlib
 
@@ -75,14 +179,8 @@ def main() -> None:
               for w, s, _ in pts]
         ax.plot(xs, [v for _, _, v in pts], color=BLUE, lw=2.0,
                 solid_capstyle="round", zorder=3)
-        ax.set_facecolor("white")
+        _style_axis(ax)
         ax.set_title(tag, fontsize=9.5, color=INK, loc="left")
-        ax.grid(True, color=GRID, lw=0.7, zorder=0)
-        for s in ("top", "right"):
-            ax.spines[s].set_visible(False)
-        for s in ("left", "bottom"):
-            ax.spines[s].set_color(GRID)
-        ax.tick_params(colors=MUTED, labelsize=8)
     axes[-1, 0].set_xlabel(
         "wall-clock (minutes)" if args.x == "wall" else "learner step",
         fontsize=9, color=MUTED)
