@@ -47,7 +47,15 @@ Three measurements, merged into ONE printed JSON line:
    device time — how much of the serial ``act`` cost the pipeline
    hides under host work).
 
-6. **e2e** — the BASELINE.md north-star accounting: env frames/sec with
+6. **device_env** — the ISSUE-7 on-device env fleet: env frames/s of
+   the host Python ``VectorEnv`` vs the native C++ stepper vs the
+   pure-JAX device env (one scan advancing N envs per dispatch) at
+   N in {64, 256, 1024}, plus the fused rollout engine
+   (env+policy+n-step+replay-ring in ONE donated program) with the
+   engine-cost (linear) and production (CNN) policies, and the
+   ``speedup_vs_host`` headline the ROADMAP open item 1 tracks.
+
+7. **e2e** — the BASELINE.md north-star accounting: env frames/sec with
    live actors + learner.  Runs the real config-8 topology (process
    backend, native batched pong stepper, HBM replay, replay-ratio
    pacing, and the ISSUE-4 actor plane: pipelined actors, or the
@@ -1026,6 +1034,210 @@ def bench_actor_pipeline(envs: int = 16, ticks: int = 300) -> dict:
     return {"actor_pipeline": out}
 
 
+def _device_env_linear_policy(state_shape):
+    """A fixed random linear Q-head over the flattened obs: the
+    cheapest policy that still exercises the rollout engine's full
+    per-tick structure (forward -> eps-greedy -> env -> n-step ->
+    ring).  Engine-cost rows use it so the section separates what the
+    ROLLOUT PLANE costs from what the configured model costs (on a CPU
+    host the Nature CNN forward alone caps any actor plane at ~1k
+    frames/s; on a TPU it is noise)."""
+    import jax.numpy as jnp
+
+    dim = int(np.prod(state_shape))
+    w = jnp.asarray(np.random.default_rng(0).normal(
+        size=(dim, 6)).astype(np.float32) * 0.01)
+
+    def apply_fn(params, obs):
+        x = obs.reshape((obs.shape[0], -1)).astype(jnp.float32) / 255.0
+        return x @ params
+
+    return apply_fn, w
+
+
+def bench_device_env(ns=(64, 256, 1024), scan_ticks: int = 8,
+                     smoke: bool = False) -> dict:
+    """The ISSUE-7 device env fleet section: env frames/s of the three
+    env backends at N in ``ns`` plus the fused rollout engine.
+
+    - ``ladder`` — env-STEPPING throughput per backend: the Python
+      ``VectorEnv`` (the reference-shaped host path), the C++ batched
+      stepper (when the toolchain builds it), and the device env (one
+      jitted scan advancing all N pure-JAX envs ``scan_ticks`` ticks
+      per dispatch).  All three produce the full 84x84 uint8 stacked
+      observation per tick; actions are held fixed, as in the
+      actor-pipeline section's env-only ceiling.
+    - ``fused`` — the COMPLETE device actor plane per dispatch
+      (models/policies.build_fused_rollout, emit="replay"): policy
+      forward + eps-greedy + env + on-device n-step assembly +
+      transitions scattered straight into a device replay ring with
+      zero host round-trip.  Two policies: ``linear`` (engine cost —
+      what the rollout plane itself costs) and ``cnn`` (the production
+      Nature-CNN policy; on CPU hosts its forward dominates, which the
+      row's ``policy_bound`` flag says explicitly).
+    - ``speedup_vs_host`` — device ladder row over the Python host row
+      at the widest N: the acceptance figure (>= 10x on this image's
+      CPU: the host plane pays ~N Python frames per tick, the device
+      plane one dispatch).
+
+    Window timing is fetch-bounded like every other section (a value
+    fetch chains behind the dispatched work).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.envs.device_env import build_device_env
+    from pytorch_distributed_tpu.envs.vector import VectorEnv
+    from pytorch_distributed_tpu.envs.pong_sim import PongSimEnv
+    from pytorch_distributed_tpu.memory.device_replay import DeviceReplay
+    from pytorch_distributed_tpu.models.policies import (
+        build_fused_rollout, init_rollout_carry,
+    )
+
+    if smoke:
+        ns = (32,)
+    opt = build_options(4, visualize=False)
+    K = scan_ticks
+    out: dict = {"n_ladder": list(ns), "scan_ticks": K, "ladder": {}}
+
+    def median_windows(tick_fn, frames_per_tick: int, ticks: int,
+                       windows: int = 5):
+        """Median frames/s over independent windows (the bench-wide
+        convention: one scheduler stall must not skew a row), with a
+        gc pass first so a previous row's teardown is not billed
+        here."""
+        import gc
+
+        gc.collect()
+        tick_fn()  # warm (compile / allocator settle)
+        rates = []
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(ticks):
+                tick_fn()
+            rates.append(frames_per_tick * ticks
+                         / (time.perf_counter() - t0))
+        return float(np.median(rates))
+
+    def host_row(N: int):
+        env = VectorEnv([PongSimEnv(opt.env_params, j) for j in range(N)])
+        env.reset()
+        acts = np.zeros(N, dtype=np.int64)
+        return median_windows(lambda: env.step(acts), N,
+                              ticks=max(2, 1024 // N))
+
+    def native_row(N: int):
+        try:
+            from pytorch_distributed_tpu.envs.native_pong import (
+                NativePongVectorEnv, get_lib,
+            )
+
+            get_lib()
+        except Exception:  # noqa: BLE001 - no toolchain: row omitted
+            return None
+        env = NativePongVectorEnv(opt.env_params, 0, N)
+        env.reset()
+        acts = np.zeros(N, dtype=np.int64)
+        return median_windows(lambda: env.step(acts), N,
+                              ticks=max(2, 4096 // N))
+
+    def device_row(N: int):
+        env = build_device_env(opt.env_params, 0, N)
+        acts = jnp.zeros((N,), jnp.int32)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def scan_steps(state):
+            def body(s, _):
+                s, out_ = env.step(s, acts)
+                return s, out_.reward
+
+            s, r = jax.lax.scan(body, state, None, length=K)
+            return s, r
+
+        box = [env.init()]
+
+        def tick():
+            box[0], r = scan_steps(box[0])
+            float(jax.device_get(r[-1][0]))  # fetch-bounded
+        return median_windows(tick, N * K,
+                              ticks=max(1, 8192 // (K * N)))
+
+    for N in ns:
+        row = {"host_frames_per_sec": round(host_row(N), 1)}
+        nat = native_row(N)
+        if nat is not None:
+            row["native_frames_per_sec"] = round(nat, 1)
+        row["device_frames_per_sec"] = round(device_row(N), 1)
+        out["ladder"][str(N)] = row
+        print(f"[bench_device_env] N={N}: {row}", file=sys.stderr,
+              flush=True)
+
+    # ---- fused rollout engine (emit="replay": zero-copy into HBM) ----
+    def fused_row(N: int, policy: str):
+        env = build_device_env(opt.env_params, 0, N)
+        if policy == "linear":
+            apply_fn, params = _device_env_linear_policy(env.state_shape)
+        else:
+            from pytorch_distributed_tpu.models import DqnCnnModel
+
+            model = DqnCnnModel(action_space=6, norm_val=255.0)
+            params = model.init(jax.random.PRNGKey(0),
+                                np.zeros((1, 4, 84, 84), np.uint8))
+            apply_fn = model.apply
+        ring = DeviceReplay(capacity=max(2 * K * N, 2048),
+                            state_shape=env.state_shape,
+                            state_dtype=np.uint8)
+        roll = build_fused_rollout(apply_fn, env, nstep=5, gamma=0.99,
+                                   rollout_ticks=K, emit="replay")
+        eps = jnp.full((N,), 0.1, jnp.float32)
+        key = jnp.asarray(jax.random.PRNGKey(0))
+        box = [init_rollout_carry(env, 5), ring.state, jnp.int32(0)]
+
+        def tick():
+            carry, rs, tick0 = box
+            carry, rs, stats = roll(params, carry, rs, key, tick0, eps)
+            int(jax.device_get(stats.fed))  # fetch-bounded
+            box[:] = [carry, rs, tick0 + K]
+
+        return median_windows(
+            tick, N * K,
+            ticks=max(1, (2048 if policy == "linear" else 256)
+                      // (K * N)),
+            windows=3 if policy == "linear" else 2)
+
+    out["fused"] = {}
+    fused_ns = ns if not smoke else (32,)
+    for N in fused_ns:
+        row = {"linear_frames_per_sec": round(fused_row(N, "linear"), 1)}
+        if not smoke:
+            row["cnn_frames_per_sec"] = round(fused_row(N, "cnn"), 1)
+            # on CPU hosts the Nature-CNN forward alone is the wall;
+            # flag it so the row is read as a model cost, not an
+            # engine cost
+            row["policy_bound"] = bool(
+                row["cnn_frames_per_sec"]
+                < 0.5 * row["linear_frames_per_sec"])
+        out["fused"][str(N)] = row
+        print(f"[bench_device_env] fused N={N}: {row}", file=sys.stderr,
+              flush=True)
+
+    top = str(max(ns))
+    host = out["ladder"][top]["host_frames_per_sec"]
+    dev = out["ladder"][top]["device_frames_per_sec"]
+    out["host_frames_per_sec"] = host
+    out["device_frames_per_sec"] = dev
+    out["fused_frames_per_sec"] = out["fused"][top][
+        "linear_frames_per_sec"]
+    if host:
+        out["speedup_vs_host"] = round(dev / host, 2)
+    # the ROADMAP open-item-1 read: with the env fleet on device, the
+    # actor plane stops being bound by the host env step — what binds
+    # next is the policy forward (CPU) or the ingest plane (TPU)
+    out["host_step_bound"] = False
+    return {"device_env": out}
+
+
 def bench_e2e(seconds: float = 60.0, actors: int = 1,
               envs_per_actor: int = 16,
               actor_backend: str | None = None) -> dict:
@@ -1049,11 +1261,13 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
     if actor_backend is None:
         # with an accelerator present the learner parent owns it and can
         # host the SEED-style inference batcher — actor ticks stop being
-        # host-CPU convnet forwards (ISSUE 4); CPU-only hosts keep the
-        # local pipelined loop
+        # host-CPU convnet forwards (ISSUE 4); CPU-only hosts run the
+        # ISSUE-7 device actor plane: the env fleet is a pure-JAX scan
+        # fused with the policy, so NO host env step exists at all (the
+        # config-8 pong-sim env has a device implementation)
         actor_backend = ("batched"
                          if jax.devices()[0].platform != "cpu"
-                         else "pipelined")
+                         else "device")
 
     t_start = time.perf_counter()
 
@@ -1118,7 +1332,8 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
     breakdown = {}
     for tag in ("actor/time_act_ms", "actor/time_env_ms",
                 "actor/time_advance_ms", "actor/time_sync_ms",
-                "actor/time_dispatch_ms", "actor/time_param_swap_ms"):
+                "actor/time_dispatch_ms", "actor/time_param_swap_ms",
+                "actor/time_rollout_ms", "actor/time_emit_ms"):
         vals = [r["value"] for r in rows
                 if r["tag"] == tag and r["wall"] >= cut]
         if vals:
@@ -1139,6 +1354,15 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
         if hidden + wait > 0:
             out["e2e_overlap_efficiency"] = round(
                 hidden / (hidden + wait), 4)
+    if actor_backend == "device":
+        # the ISSUE-7 read: the actor plane has NO host env step — its
+        # tick breakdown is the fused device dispatch (rollout), the
+        # once-per-dispatch chunk fetch (emit) and the replay feed
+        # (advance); time_env_ms cannot appear by construction
+        out["e2e_host_env_step_ms"] = 0.0
+        out["e2e_actor_plane"] = (
+            "device rollout (fused env+policy+nstep scan) — actor "
+            "plane no longer bound by the host env step")
     return out
 
 
@@ -1146,7 +1370,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("micro", "e2e", "both", "families",
                                        "sampler", "act", "actor",
-                                       "health", "perf"),
+                                       "health", "perf", "device_env"),
                     default="both")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale CPU-safe bench (the dqn-mlp "
@@ -1157,9 +1381,10 @@ def main() -> None:
     ap.add_argument("--e2e-actors", type=int, default=1)
     ap.add_argument("--e2e-envs", type=int, default=16)
     ap.add_argument("--e2e-actor-backend", type=str, default=None,
-                    choices=("inline", "pipelined", "batched"),
+                    choices=("inline", "pipelined", "batched", "device"),
                     help="override the e2e actor schedule (default: "
-                         "batched on accelerator hosts, else pipelined)")
+                         "batched on accelerator hosts, else the "
+                         "ISSUE-7 device env fleet)")
     ap.add_argument("--actor-envs", type=int, default=16,
                     help="env-vector width for the actor-pipeline section")
     ap.add_argument("--actor-ticks", type=int, default=300)
@@ -1176,8 +1401,15 @@ def main() -> None:
     result = {}
     if args.smoke:
         result.update(bench_smoke())
+        # seconds-scale device-env engine row (N=32, linear policy)
+        # so the gate covers the ISSUE-7 actor plane from day one
+        dev = bench_device_env(smoke=True)["device_env"]
+        result["smoke"]["device_env_frames_per_sec"] = \
+            dev["fused"]["32"]["linear_frames_per_sec"]
+        result["smoke"]["device_env_host_frames_per_sec"] = \
+            dev["ladder"]["32"]["host_frames_per_sec"]
         out = {
-            "bench_schema": 3,
+            "bench_schema": 4,
             "metric": "smoke_updates_per_sec",
             "value": result["smoke"]["updates_per_sec"],
             "unit": ("updates/s (dqn-mlp fused x8, smoke geometry — "
@@ -1204,6 +1436,8 @@ def main() -> None:
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))
+    if args.mode in ("both", "device_env"):
+        result.update(bench_device_env())
     if args.mode in ("e2e", "both"):
         result.update(bench_e2e(args.e2e_seconds, args.e2e_actors,
                                 args.e2e_envs, args.e2e_actor_backend))
@@ -1233,17 +1467,19 @@ def main() -> None:
     else:  # sampler/act-only invocations have no throughput headline
         metric, value, unit = f"bench_{args.mode}", None, "see section keys"
     out = {
-        # schema 3: the e2e section now runs the ISSUE-4 actor plane —
-        # software-pipelined actors by default, the SEED-style batched
-        # inference backend on accelerator hosts (e2e_actor_backend says
-        # which) — so e2e_frames_per_sec is not comparable to schema-2
-        # rows measured with serial host-CPU actors; adds the
-        # actor_pipeline section and e2e_overlap_efficiency.  Schema 2
-        # (r3): production-K headline, fused families rows, sampler +
+        # schema 4: adds the ISSUE-7 device_env section (on-device env
+        # fleet ladder + fused rollout engine) and the e2e default
+        # actor plane on CPU hosts becomes actor_backend=device (no
+        # host env step — e2e_frames_per_sec is not comparable to
+        # schema-3 rows measured with pipelined host-env actors;
+        # e2e_actor_backend says which plane ran).  Schema 3: e2e runs
+        # the ISSUE-4 actor plane (pipelined/batched), actor_pipeline
+        # section, e2e_overlap_efficiency.  Schema 2 (r3):
+        # production-K headline, fused families rows, sampler +
         # act-A/B sections.  Bump whenever a key's MEANING changes so
         # longitudinal consumers never compare across semantics
         # (round-3 advisor finding).
-        "bench_schema": 3,
+        "bench_schema": 4,
         "metric": metric,
         "value": value,
         "unit": unit,

@@ -57,8 +57,8 @@ import numpy as np
 
 from pytorch_distributed_tpu.config import Options
 from pytorch_distributed_tpu.factory import (
-    EnvSpec, build_env_vector, build_model, init_params,
-    resolve_actor_backend,
+    EnvSpec, build_device_env, build_env_vector, build_model,
+    init_params, resolve_actor_backend,
 )
 from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
 from pytorch_distributed_tpu.agents.param_store import (
@@ -92,8 +92,16 @@ class _ActorHarness:
         self.backend = backend
 
         self.num_envs = max(1, opt.env_params.num_envs_per_actor)
-        self.env = build_env_vector(opt, process_ind, self.num_envs)
-        self.env.train()
+        if backend == "device":
+            # Sebulba actor (ISSUE 7): the env fleet is a pure-JAX
+            # program advanced inside the fused rollout dispatch — no
+            # host env objects exist in this process at all
+            self.env = None
+            self.device_env = build_device_env(opt, process_ind,
+                                               self.num_envs)
+        else:
+            self.env = build_env_vector(opt, process_ind, self.num_envs)
+            self.env.train()
         self._prefetch: Optional[ParamPrefetcher] = None
         if backend == "batched":
             # SEED-style actor: inference lives with the accelerator, so
@@ -172,7 +180,7 @@ class _ActorHarness:
         # makes this worker stop progressing without exiting, the drill
         # the watchdog must catch).  Test clocks may lack the surface.
         self._bump_progress = getattr(clock, "bump_progress",
-                                      lambda label: None)
+                                      lambda label, n=1: None)
         self._progress_label = f"actor-{process_ind}"
         self._faults = FaultInjector.from_env("actor")
 
@@ -554,6 +562,130 @@ def _drive_actor_loop(h: _ActorHarness, engine, clock: GlobalClock,
     return h
 
 
+def _drive_device_actor_loop(h: _ActorHarness, clock: GlobalClock,
+                             base_key, eps) -> _ActorHarness:
+    """The Sebulba actor loop (ISSUE 7): no per-tick host work at all.
+
+    One fused, donated XLA program advances all N envs x K ticks —
+    policy forward, row-keyed eps-greedy, env physics/render, n-step
+    assembly — and the host's whole job per dispatch is ONE packed
+    device->host copy of the emitted transition chunk plus the feed
+    into the replay plane.  Action streams are bit-identical to the
+    inline loop over the same device env (the tick_keys contract), and
+    the emitted transition stream is bit-identical to what the host
+    ``NStepAssembler`` would produce from those ticks
+    (tests/test_device_env.py pins both).
+
+    Cadences quantize to the dispatch: the weight-sync check, stat
+    flush, watchdog liveness marks and fault frames all run once per
+    K-tick dispatch instead of per tick.  Timer phases: ``rollout``
+    (dispatch issue), ``emit`` (blocked on the program + the chunk
+    D2H), ``advance`` (replay feed + episode accounting),
+    ``param_swap`` (the prefetched weight swap)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.policies import (
+        build_fused_rollout, init_rollout_carry, rollout_priorities,
+    )
+    from pytorch_distributed_tpu.utils.experience import Transition
+
+    ap = h.ap
+    N = h.num_envs
+    K = max(1, int(getattr(h.opt.env_params, "device_rollout_ticks", 8)))
+    env = h.device_env
+    rollout = build_fused_rollout(h.model.apply, env, nstep=ap.nstep,
+                                  gamma=ap.gamma, rollout_ticks=K,
+                                  emit="chunk")
+    h.rollout_jit = rollout  # introspection: tests/bench read the cache
+    # perf plane: the fused rollout is a registered hot program (a
+    # post-warmup recompile = a shape/dtype leak paying compile latency
+    # on the hot path) and its per-frame FLOPs feed the actor-side MFU
+    # on the live plane (utils/perf.py flops_per_frame)
+    h.perf.register_jit("device_rollout", rollout._cache_size)
+    carry = init_rollout_carry(env, ap.nstep)
+    eps_dev = jnp.asarray(eps, jnp.float32)
+    key_dev = jnp.asarray(base_key)
+    if h.perf.enabled:
+        # XLA's cost analysis counts the K-tick scan body ONCE
+        # (verified: totals are K-invariant, utils/perf.
+        # flops_of_compiled docstring), so the per-call figure is one
+        # tick of all N envs — divide by N, not K*N
+        h.perf.capture_frame_flops(
+            lambda: rollout.lower(h.params, carry, key_dev,
+                                  jnp.int32(0), eps_dev),
+            frames_per_call=N)
+    timer = h.timer
+    # tick0 stays DEVICE-resident and advances on device (+K is a weak
+    # python constant): the audited dispatch must stage zero host
+    # arrays, so the transfer audit (TPU_APEX_PERF_TRANSFER_AUDIT=1)
+    # proves the hot path transfer-free instead of flagging its own
+    # tick counter
+    tick0 = jnp.int32(0)
+    audit = h.perf.audit
+    while not clock.done(ap.steps):
+        t0 = time.perf_counter()
+        if audit is not None:
+            carry, chunk = audit.run(rollout, h.params, carry, key_dev,
+                                     tick0, eps_dev)
+        else:
+            carry, chunk = rollout(h.params, carry, key_dev, tick0,
+                                   eps_dev)
+        tick0 = tick0 + K
+        timer.add("rollout", time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        ch = jax.device_get(chunk)  # the dispatch's ONE device->host copy
+        timer.add("emit", time.perf_counter() - t0)
+        # ---- per-dispatch cadence (the vector ticks' tick_sync) ----
+        h.env_steps += K * N
+        h.perf.note_frames(K * N)
+        h.clock.add_actor_steps(K * N)
+        # one liveness mark covering the dispatch's K vector ticks:
+        # mark counts stay in tick units, so the fleet STATUS per-actor
+        # frames/s (marks x num_envs / dt) is backend-invariant
+        h._bump_progress(h._progress_label, n=K)
+        h._faults.data_frame(())
+        h._acc["total_nframes"] += K * N
+        if h.env_steps >= h._next_sync:
+            h._next_sync += ap.actor_sync_freq
+            if h._prefetch is not None:
+                t0 = time.perf_counter()
+                got = h._prefetch.take()
+                if got is not None:
+                    h.params, h.version = got
+                    timer.add("param_swap", time.perf_counter() - t0)
+        with timer.phase("advance"):
+            valid = np.asarray(ch.valid)
+            prio = None
+            if h.per_priorities:
+                flat = {f: np.asarray(getattr(ch, f)).reshape(
+                    (K * N,) + np.asarray(getattr(ch, f)).shape[2:])
+                    for f in ("reward", "gamma_n", "terminal1",
+                              "q_boot", "q_sel", "prio_ok")}
+                prio = rollout_priorities(flat, True).reshape(K, N)
+            for k in range(K):
+                for j in range(N):
+                    if not valid[k, j]:
+                        continue
+                    t = Transition(
+                        state0=ch.state0[k, j], action=ch.action[k, j],
+                        reward=ch.reward[k, j],
+                        gamma_n=ch.gamma_n[k, j],
+                        state1=ch.state1[k, j],
+                        terminal1=ch.terminal1[k, j])
+                    h.memory.feed(t, prio[k][j] if prio is not None
+                                  else None)
+                # episode accounting off the per-tick env stats
+                h.episode_reward += np.asarray(ch.step_reward[k],
+                                               np.float64)
+                h.episode_steps += 1
+                for j in np.nonzero(np.asarray(ch.step_terminal[k]))[0]:
+                    h._record_episode(int(j), {})
+            h._flush_cadence()
+    h.shutdown()
+    return h
+
+
 def run_dqn_actor(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                   param_store: ParamStore, clock: GlobalClock,
                   stats: ActorStats, inference: Any = None):
@@ -567,6 +699,8 @@ def run_dqn_actor(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     eps = apex_epsilons(process_ind, opt.num_actors, h.num_envs,
                         h.ap.eps, h.ap.eps_alpha)
     base_key = process_key(opt.seed, "actor", process_ind)
+    if backend == "device":
+        return _drive_device_actor_loop(h, clock, base_key, eps)
     if backend == "batched":
         engine = _BatchedDqnEngine(inference, base_key, eps)
     else:

@@ -46,11 +46,15 @@ class GlobalClock:
         # shared Values ride the clock's spawn pickle into every child.
         self.progress = None
 
-    def bump_progress(self, label: str) -> None:
+    def bump_progress(self, label: str, n: int = 1) -> None:
         """Stamp a liveness-progress mark for ``label`` (e.g.
-        ``actor-3``); no-op when no watchdog board is attached."""
+        ``actor-3``); no-op when no watchdog board is attached.  ``n``
+        is the number of work units the mark covers (a fused device
+        dispatch marks once for its K vector ticks), so mark COUNTS
+        stay in vector-tick units across backends — the fleet STATUS
+        per-actor frames/s derives from them."""
         if self.progress is not None:
-            self.progress.bump(label)
+            self.progress.bump(label, n)
 
     def add_skipped_steps(self, n: int) -> None:
         with self.skipped_steps.get_lock():

@@ -87,7 +87,7 @@ class EnvParams:
     # (utils/options.py:32, atari_env.py:15); here >1 is supported by the
     # sim envs and batched inference.
     num_envs_per_actor: int = 1
-    # Actor hot-loop schedule/placement (ISSUE 4):
+    # Actor hot-loop schedule/placement (ISSUE 4 + ISSUE 7):
     #   "pipelined" — two-stage software pipeline (default): the jitted
     #                 act for tick k+1 is dispatched asynchronously while
     #                 the host feeds tick k; bit-identical streams to
@@ -100,7 +100,29 @@ class EnvParams:
     #                 dqn/ddpg with a co-located server only; downgrades
     #                 to "pipelined" otherwise (factory.
     #                 resolve_actor_backend).
+    #   "device"    — Sebulba/Anakin on-device env fleet (ISSUE 7): the
+    #                 env itself is a pure-JAX program
+    #                 (envs/device_env.py) and ONE donated scan advances
+    #                 all N envs x device_rollout_ticks ticks fused with
+    #                 the policy forward and on-device n-step assembly
+    #                 (models/policies.build_fused_rollout) — no host
+    #                 env step at all; one D2H per dispatch ships the
+    #                 finished transition chunk.  dqn families with a
+    #                 device env implementation only (pong-sim);
+    #                 downgrades to "pipelined" otherwise.
     actor_backend: str = "pipelined"
+    # Ticks per fused device rollout dispatch (actor_backend="device"):
+    # K env steps of all N envs run inside one XLA program, amortizing
+    # dispatch latency and the chunk D2H over K*N frames.  Weight-sync
+    # and stat cadences quantize to K ticks.
+    device_rollout_ticks: int = 8
+    # Device env family selector: "auto" derives it from env_type
+    # (pong-sim -> the "pong" device port).  Naming a family explicitly
+    # pins/documents the choice and must MATCH the env_type's own
+    # device family (a family can never substitute a different game
+    # than the host config runs — mismatches raise).
+    # envs/device_env.DEVICE_ENV_FAMILIES.
+    device_env_family: str = "auto"
     render: bool = False
     # Step sim envs through the first-party C++ batched stepper
     # (native/pong_batch.cpp) when the toolchain builds it; the Python

@@ -2,8 +2,12 @@ from pytorch_distributed_tpu.envs.base import DiscreteSpace, ContinuousSpace, En
 from pytorch_distributed_tpu.envs.fake_env import FakeChainEnv
 from pytorch_distributed_tpu.envs.classic import CartPoleEnv, PendulumEnv, make_classic_env
 from pytorch_distributed_tpu.envs.pong_sim import PongSimEnv
+from pytorch_distributed_tpu.envs.device_env import (
+    DeviceEnv, DevicePongVectorEnv, make_device_pong,
+)
 
 __all__ = [
     "Env", "DiscreteSpace", "ContinuousSpace", "FakeChainEnv",
     "CartPoleEnv", "PendulumEnv", "make_classic_env", "PongSimEnv",
+    "DeviceEnv", "DevicePongVectorEnv", "make_device_pong",
 ]

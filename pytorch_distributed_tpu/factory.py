@@ -101,7 +101,7 @@ def get_worker(role: str, agent_type: str) -> Callable:
 # Actor backend routing (ISSUE 4)
 # ---------------------------------------------------------------------------
 
-ACTOR_BACKENDS = ("inline", "pipelined", "batched")
+ACTOR_BACKENDS = ("inline", "pipelined", "batched", "device")
 
 
 def resolve_actor_backend(opt: Options, inference=None) -> str:
@@ -113,10 +113,11 @@ def resolve_actor_backend(opt: Options, inference=None) -> str:
     to build an InferenceServer from the same predicate via
     ``needs_inference_server``) and the fleet CLI — so the pieces can
     never disagree.  ``batched`` needs a co-located server handle
-    (``inference``) and a flat family; anything else downgrades to
-    ``pipelined`` with a loud warning rather than failing a whole fleet
-    over a placement detail (remote DCN actor hosts have no server to
-    reach)."""
+    (``inference``) and a flat family; ``device`` needs a dqn family
+    whose env has a pure-JAX implementation (envs/device_env.py);
+    anything else downgrades to ``pipelined`` with a loud warning
+    rather than failing a whole fleet over a placement detail (remote
+    DCN actor hosts have no server to reach)."""
     backend = getattr(opt.env_params, "actor_backend", "pipelined") \
         or "pipelined"
     if backend not in ACTOR_BACKENDS:
@@ -138,7 +139,39 @@ def resolve_actor_backend(opt: Options, inference=None) -> str:
                 "in (remote actor host, or a topology without the "
                 "server); falling back to pipelined", stacklevel=2)
             return "pipelined"
+    if backend == "device":
+        import warnings
+
+        from pytorch_distributed_tpu.envs.device_env import (
+            device_env_supported,
+        )
+
+        if opt.agent_type != "dqn":
+            warnings.warn(
+                f"actor_backend=device serves the flat dqn family only "
+                f"(got agent_type={opt.agent_type}); falling back to "
+                f"pipelined", stacklevel=2)
+            return "pipelined"
+        if not device_env_supported(opt.env_params):
+            warnings.warn(
+                f"actor_backend=device but env_type="
+                f"{opt.env_params.env_type!r} has no device env "
+                f"implementation (envs/device_env.DEVICE_ENV_FAMILIES); "
+                f"falling back to pipelined", stacklevel=2)
+            return "pipelined"
     return backend
+
+
+def build_device_env(opt: Options, process_ind: int, num_envs: int):
+    """The pure-JAX env fleet for one device-backend actor slot
+    (envs/device_env.py), seeded on the SAME slot contract as
+    ``build_env_vector`` (env j of actor i takes slot ``seed + i*N +
+    j``) so backend choice never changes the seed stream."""
+    from pytorch_distributed_tpu.envs.device_env import (
+        build_device_env as _build,
+    )
+
+    return _build(opt.env_params, process_ind, num_envs)
 
 
 def needs_inference_server(opt: Options) -> bool:
@@ -178,12 +211,30 @@ def build_env(opt: Options, process_ind: int = 0):
     return ctor(opt.env_params, process_ind)
 
 
+def device_backend_active(opt: Options) -> bool:
+    """Whether actor slots will run the device env fleet — the
+    eligibility part of ``resolve_actor_backend``'s device gate,
+    callable without triggering its downgrade warnings (the parent's
+    prebuild must not warn about an inference server that is wired
+    later)."""
+    from pytorch_distributed_tpu.envs.device_env import (
+        device_env_supported,
+    )
+
+    return (getattr(opt.env_params, "actor_backend", "") == "device"
+            and opt.agent_type == "dqn"
+            and device_env_supported(opt.env_params))
+
+
 def _wants_native_pong(opt: Options) -> bool:
     """One gate for the native pong stepper, shared by the construction
     path (build_env_vector) and the parent-side prebuild (prebuild_native)
-    so the two can't drift."""
-    return opt.env_type == "pong-sim" and getattr(opt.env_params,
-                                                  "native_env", True)
+    so the two can't drift.  Device-backend runs skip it: no actor will
+    dlopen the library (the env fleet is a pure-JAX program; the
+    evaluator's single env never routes through the batched stepper)."""
+    return (opt.env_type == "pong-sim"
+            and getattr(opt.env_params, "native_env", True)
+            and not device_backend_active(opt))
 
 
 def build_env_vector(opt: Options, process_ind: int, num_envs: int):

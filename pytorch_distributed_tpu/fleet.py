@@ -86,6 +86,13 @@ class FleetTopology(Topology):
         # requests land on concurrent gateway serve threads
         self._rate_lock = threading.Lock()
         self._rate_prev = None  # (monotonic, learner_step) of last probe
+        # the schedule local actor slots actually run, post-downgrade
+        # (resolve may warn about a downgrade — once, here, not per
+        # STATUS probe)
+        from pytorch_distributed_tpu.factory import resolve_actor_backend
+
+        self._actor_backend = resolve_actor_backend(
+            opt, self.inference_server)
         self.gateway = self._make_gateway(port)
         self.port = self.gateway.port
         if self.perf.enabled:
@@ -163,6 +170,12 @@ class FleetTopology(Topology):
         now = time.monotonic()
         step = int(self.clock.learner_step.value)
         astep = int(self.clock.actor_step.value)
+        # per-LOCAL-actor vector-tick marks off the watchdog's progress
+        # board (each actor bumps once per tick / per fused dispatch's
+        # K ticks), so the panel can attribute the fleet rate to slots
+        n_envs = max(1, self.opt.env_params.num_envs_per_actor)
+        marks = {i: self.progress_board.marks(f"actor-{i}")
+                 for i in range(self.local_actors)}
         with self._rate_lock:
             prev = self._rate_prev
             # advance the window anchor only after it has real width:
@@ -170,7 +183,7 @@ class FleetTopology(Topology):
             # would otherwise shrink each other's windows to a few ms,
             # quantizing the rate into 0-or-thousands flapping
             if prev is None or now - prev[0] >= 0.5:
-                self._rate_prev = (now, step, astep)
+                self._rate_prev = (now, step, astep, marks)
         if prev is not None and now > prev[0]:
             h["learner_steps_per_sec"] = round(
                 (step - prev[1]) / (now - prev[0]), 3)
@@ -181,6 +194,20 @@ class FleetTopology(Topology):
             # stream; remote processes can't reach this registry)
             h["actor_frames_per_sec"] = round(
                 (astep - prev[2]) / (now - prev[0]), 3)
+            prev_marks = prev[3] if len(prev) > 3 else {}
+            if marks:
+                # ISSUE-7 satellite: per-actor env frames/s + the
+                # schedule each slot actually runs (post-downgrade),
+                # rendered by fleet_top's perf panel.  A respawned
+                # slot's marks reset (note_start) — clamp at 0 rather
+                # than report a negative rate for that window.
+                h["actors"] = {
+                    str(i): {
+                        "env_frames_per_sec": round(max(
+                            0.0, (m - prev_marks.get(i, 0)) * n_envs
+                            / (now - prev[0])), 1),
+                        "backend": self._actor_backend,
+                    } for i, m in marks.items()}
         # health-sentinel counters (utils/health.py): learner-side guard
         # skips and rollbacks ride the shared clock; quarantine counts
         # come from this process's registry (the learner-side ingest
@@ -571,7 +598,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="[actors] actors to run on this host")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--actor-backend", type=str, default=None,
-                    choices=("inline", "pipelined", "batched"),
+                    choices=("inline", "pipelined", "batched", "device"),
                     help="actor hot-loop schedule (config.py EnvParams."
                          "actor_backend): pipelined = overlapped "
                          "two-stage loop (default), inline = serial "
@@ -579,7 +606,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "inference on the learner host — applies to "
                          "that host's LOCAL actors; remote actor hosts "
                          "have no co-located server and auto-downgrade "
-                         "to pipelined (factory.resolve_actor_backend)")
+                         "to pipelined; device = Sebulba on-device env "
+                         "fleet (pure-JAX envs fused with the policy "
+                         "into one scan, envs/device_env.py — dqn + "
+                         "device-implemented envs only, others "
+                         "downgrade) (factory.resolve_actor_backend)")
     ap.add_argument("--resume", type=str, default=None, metavar="REFS",
                     help="[learner] resume run REFS from its newest "
                          "complete checkpoint epoch (models/REFS_ckpt — "

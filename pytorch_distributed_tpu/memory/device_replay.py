@@ -62,6 +62,35 @@ def _feed(state: ReplayState, chunk: Transition, capacity: int) -> ReplayState:
     return ring_write(state, chunk, capacity)[0]
 
 
+def ring_write_masked(state, chunk: Transition, valid,
+                      capacity: int):
+    """Write only the ``valid`` rows of a chunk at the cursor, in chunk
+    order, inside jit — the device actor plane's ingest primitive
+    (models/policies.build_fused_rollout emit="replay"): the fused
+    rollout's per-tick emissions carry a validity column (warmup ticks
+    have no closed n-step window yet), and invalid rows must neither
+    consume ring slots nor corrupt neighbours.
+
+    Valid rows take positions ``pos + rank`` (rank = prefix count of
+    valid rows); invalid rows are pointed at index ``capacity`` —
+    out of bounds — and dropped by the scatter (``mode="drop"``), which
+    XLA resolves with no branch.  Returns ``(state', n_written)``."""
+    offs = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    idx = jnp.where(valid, (state.pos + offs) % capacity, capacity)
+    total = jnp.sum(valid.astype(jnp.int32))
+    wr = lambda buf, x: buf.at[idx].set(x, mode="drop")
+    return state._replace(
+        state0=wr(state.state0, chunk.state0),
+        action=wr(state.action, chunk.action),
+        reward=wr(state.reward, chunk.reward),
+        gamma_n=wr(state.gamma_n, chunk.gamma_n),
+        state1=wr(state.state1, chunk.state1),
+        terminal1=wr(state.terminal1, chunk.terminal1),
+        pos=(state.pos + total) % capacity,
+        fill=jnp.minimum(state.fill + total, capacity),
+    ), total
+
+
 def chunk_to_nhwc(chunk: Transition) -> Transition:
     """Transpose a chunk's (N, C, H, W) states to (N, H, W, C) — runs
     inside the jitted feed, so a channels-last ring pays the layout copy

@@ -12,10 +12,11 @@ parts").
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def apex_epsilon(process_ind: int, num_actors: int,
@@ -176,6 +177,310 @@ def build_packed_act_rowkeys(apply_fn: Callable) -> Callable:
         return _pack_dqn(q, _rowwise_eps_greedy(q, row_keys, eps))
 
     return jax.jit(act)
+
+
+# ---------------------------------------------------------------------------
+# The fused device rollout (ISSUE 7 tentpole): env + policy + n-step
+# assembly in ONE donated on-device scan.
+# ---------------------------------------------------------------------------
+
+from typing import NamedTuple  # noqa: E402
+
+
+class RolloutCarry(NamedTuple):
+    """Everything the fused rollout keeps device-resident between
+    dispatches: the env fleet's state and the open n-step windows.
+
+    Window bookkeeping implements EXACTLY the ``ops/nstep.py``
+    assembler semantics, restructured for fixed shapes: every env tick
+    t opens exactly one window (s_t, a_t); a window closes when it
+    accumulates ``nstep`` rewards or the episode ends (true terminals
+    mark ``terminal1``; truncation closes but still bootstraps); and
+    every window is EMITTED a fixed ``nstep`` ticks after it opened —
+    by which point it is guaranteed closed and its bootstrap q_max
+    (the NEXT forward after its close, the same forward the host
+    actor's pending-queue used) has been stamped.  Fixed delay means
+    exactly one emission slot per env per tick — no data-dependent
+    output shapes — at the cost of rings of the last ``nstep + 1``
+    ticks of per-window state and true post-step observations."""
+
+    env_state: Any
+    win_s0: Any          # (N, R, *obs) uint8 — s0 of window per slot
+    win_action: Any      # (N, R) int32
+    win_qsel: Any        # (N, R) f32 — q(s0, a) at open
+    win_racc: Any        # (N, R) f32 — discounted reward accumulator
+    win_age: Any         # (N, R) int32 — rewards accumulated
+    win_open: Any        # (N, R) bool
+    win_term: Any        # (N, R) f32 — terminal1 stamped at close
+    win_prio_ok: Any     # (N, R) bool — False for truncated closes
+    win_close_slot: Any  # (N, R) int32 — obs_true slot of the close
+    win_qboot: Any       # (N, R) f32 — bootstrap q_max, stamped late
+    win_need_boot: Any   # (N, R) bool — closed, awaiting next forward
+    obs_true: Any        # (N, R, *obs) uint8 — true post-step obs ring
+
+
+class RolloutChunk(NamedTuple):
+    """Per-dispatch emission: ``(K, N)``-leading transition columns
+    (the six replay fields) plus the PER scalars and per-tick env
+    stats.  ``valid`` is False only for the run's first ``nstep``
+    warmup ticks.  ``prio_ok`` False marks truncated-close windows —
+    the host path feeds those with priority None (new-sample max)."""
+
+    state0: Any
+    action: Any
+    reward: Any
+    gamma_n: Any
+    state1: Any
+    terminal1: Any
+    valid: Any
+    q_sel: Any
+    q_boot: Any
+    prio_ok: Any
+    step_reward: Any     # (K, N) f32 raw per-tick env rewards
+    step_terminal: Any   # (K, N) bool
+    step_truncated: Any  # (K, N) bool
+
+
+class RolloutStats(NamedTuple):
+    """The replay-emit variant's host-visible output (everything else
+    stays in HBM): per-tick env stats only."""
+
+    step_reward: Any
+    step_terminal: Any
+    step_truncated: Any
+    fed: Any             # () int32 — rows written into the ring
+
+
+def init_rollout_carry(env, nstep: int) -> RolloutCarry:
+    """Fresh carry for ``build_fused_rollout``: env at reset, no open
+    windows.  Ring depth R = nstep + 1: the emission slot (t - nstep)
+    and the open slot (t) must never collide."""
+    import jax.numpy as jnp
+
+    n = env.num_envs
+    R = nstep + 1
+    obs_shape = tuple(env.state_shape)
+    env_state = env.init()
+    z = lambda dt: jnp.zeros((n, R), dt)
+    return RolloutCarry(
+        env_state=env_state,
+        win_s0=jnp.zeros((n, R, *obs_shape), jnp.uint8),
+        win_action=z(jnp.int32), win_qsel=z(jnp.float32),
+        win_racc=z(jnp.float32), win_age=z(jnp.int32),
+        win_open=z(bool), win_term=z(jnp.float32),
+        win_prio_ok=z(bool), win_close_slot=z(jnp.int32),
+        win_qboot=z(jnp.float32), win_need_boot=z(bool),
+        obs_true=jnp.zeros((n, R, *obs_shape), jnp.uint8),
+    )
+
+
+def build_fused_rollout(apply_fn: Callable, env, *, nstep: int,
+                        gamma: float, rollout_ticks: int,
+                        emit: str = "chunk") -> Callable:
+    """ONE donated on-device scan advancing N envs x K ticks: per tick,
+    the policy forward, row-keyed eps-greedy action selection, the
+    vectorized env step, and n-step transition assembly all run inside
+    the same XLA program — obs stacks, PRNG, env state and the open
+    n-step windows never leave the device, and finished transitions
+    are emitted device-side (no per-tick H2D/D2H).
+
+    Randomness rides the exact ISSUE-4 stream contract: row keys are
+    ``tick_keys(base_key, tick, row)`` folds, so the action stream for
+    any (actor, tick, env-row) is bit-identical to what the
+    inline/pipelined/batched backends produce over the same env.
+
+    ``emit``:
+
+    - ``"chunk"`` — the scan returns a ``RolloutChunk`` of (K, N)
+      transition columns; the cross-process actor driver ships it to
+      the replay feeder with ONE device->host copy per dispatch
+      (amortized over K*N frames).  Returns a jitted
+      ``rollout(params, carry, base_key, tick0, eps) ->
+      (carry', RolloutChunk)`` with ``carry`` DONATED.
+    - ``"replay"`` — the scan scatters valid rows straight into a
+      device replay ``ReplayState`` carried through the program
+      (memory/device_replay.ring_write_masked): experience lands in
+      the learner-side HBM ring with ZERO host round-trip — the
+      co-located Sebulba topology, and the bench's fused section.
+      Returns ``rollout(params, carry, ring_state, base_key, tick0,
+      eps) -> (carry', ring_state', RolloutStats)`` with ``carry`` and
+      ``ring_state`` donated.
+
+    ``tick0`` is a traced scalar (the global tick of the dispatch's
+    first tick), so consecutive dispatches NEVER retrace; the caller
+    advances it by ``rollout_ticks`` per call.  Priorities: the chunk
+    carries ``q_sel``/``q_boot``/``prio_ok`` columns so the host can
+    form the actor-side PER priority |R + gamma_n*maxQ(s_end) - q_sel|
+    with two flops per row — same estimate, no device sync.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    assert emit in ("chunk", "replay")
+    n = env.num_envs
+    R = nstep + 1
+    K = int(rollout_ticks)
+    # f64-computed discount powers (cast once): the host assembler
+    # accumulates in python f64 and casts at emit, so a f32 pow chain
+    # here would drift a final ulp on scoring windows
+    gamma_pow = jnp.asarray(
+        np.power(np.float64(gamma), np.arange(R)).astype(np.float32))
+
+    if emit == "replay":
+        from pytorch_distributed_tpu.memory.device_replay import (
+            ring_write_masked,
+        )
+        from pytorch_distributed_tpu.utils.experience import Transition
+
+    def one_tick(params, eps, base_key, c: RolloutCarry, t):
+        obs = env.observe(c.env_state)
+        q = apply_fn(params, obs)
+        qmax = jnp.max(q, axis=-1).astype(jnp.float32)
+        # late bootstrap stamp: windows closed at t-1 take THIS
+        # forward's q_max — the same forward the host actor's pending
+        # queue resolved against (agents/actor._resolve_pending); the
+        # stamp satisfies every waiting window, so need_boot resets
+        qboot = jnp.where(c.win_need_boot, qmax[:, None], c.win_qboot)
+        need_boot = jnp.zeros_like(c.win_need_boot)
+        action = _rowwise_eps_greedy(q, tick_keys(base_key, t, n), eps)
+        q_sel = jnp.take_along_axis(
+            q, action[:, None], axis=-1)[:, 0].astype(jnp.float32)
+        env_state, out = env.step(c.env_state, action.astype(jnp.int32))
+        slot = (t % R).astype(jnp.int32)
+        cols = jnp.arange(R, dtype=jnp.int32)
+        at_slot = cols[None, :] == slot             # (1, R) -> broadcast
+        term = out.terminal
+        trunc = out.truncated
+        true_term = (term & ~trunc).astype(jnp.float32)
+
+        # slot writes via dynamic_update_index_in_dim, NOT a where over
+        # the whole ring: the obs rings are the carry's bulk (N x R
+        # stacks), and a where-based write would stream the full ring
+        # through memory every tick — measured ~4x on the whole engine
+        def set_slot(ring, val):
+            return jax.lax.dynamic_update_index_in_dim(ring, val, slot,
+                                                       axis=1)
+
+        # open this tick's window at ``slot``
+        win_s0 = set_slot(c.win_s0, obs)
+        win_action = set_slot(c.win_action, action.astype(jnp.int32))
+        win_qsel = set_slot(c.win_qsel, q_sel)
+        win_racc = set_slot(c.win_racc, jnp.zeros((n,), jnp.float32))
+        win_age = set_slot(c.win_age, jnp.zeros((n,), jnp.int32))
+        win_open = set_slot(c.win_open, jnp.ones((n,), bool))
+        # accumulate this tick's reward into every open window
+        win_racc = win_racc + jnp.where(
+            win_open, gamma_pow[win_age] * out.reward[:, None], 0.0)
+        win_age = win_age + win_open
+        # true post-step obs ring (final_obs preserves the terminal
+        # frame; non-terminal rows it equals the next obs)
+        obs_true = set_slot(c.obs_true, out.final_obs)
+        # closes: window full, or episode over (truncation included)
+        closing = win_open & ((win_age >= nstep) | term[:, None])
+        win_open = win_open & ~closing
+        win_term = jnp.where(closing, true_term[:, None], c.win_term)
+        win_prio_ok = jnp.where(closing, (~trunc)[:, None], c.win_prio_ok)
+        win_close_slot = jnp.where(closing, slot, c.win_close_slot)
+        need_boot = jnp.where(closing, (true_term == 0.0)[:, None],
+                              need_boot)
+        # emission: the window opened nstep ticks ago — closed by
+        # t-1 at the latest, boot-stamped by this tick's forward
+        slot_e = ((t - nstep) % R).astype(jnp.int32)
+        rows = jnp.arange(n)
+        valid = jnp.broadcast_to(t >= nstep, (n,))
+
+        def get_slot(ring):
+            return jax.lax.dynamic_index_in_dim(ring, slot_e, axis=1,
+                                                keepdims=False)
+
+        term1_e = get_slot(win_term)
+        close_e = get_slot(win_close_slot)
+        s1 = jnp.take_along_axis(
+            obs_true, close_e.reshape((n, 1) + (1,) * (
+                obs_true.ndim - 2)), axis=1)[:, 0]
+        emitted = dict(
+            state0=get_slot(win_s0),
+            action=get_slot(win_action),
+            reward=get_slot(win_racc),
+            gamma_n=gamma_pow[get_slot(win_age)],
+            state1=s1,
+            terminal1=term1_e,
+            valid=valid,
+            q_sel=get_slot(win_qsel),
+            # true terminals never bootstrap; zeroing the column keeps
+            # the chunk self-describing (stale slot values otherwise)
+            q_boot=jnp.where(term1_e > 0, 0.0, get_slot(qboot)),
+            prio_ok=get_slot(win_prio_ok),
+        )
+        carry = RolloutCarry(
+            env_state=env_state, win_s0=win_s0, win_action=win_action,
+            win_qsel=win_qsel, win_racc=win_racc, win_age=win_age,
+            win_open=win_open, win_term=win_term,
+            win_prio_ok=win_prio_ok, win_close_slot=win_close_slot,
+            win_qboot=qboot, win_need_boot=need_boot,
+            obs_true=obs_true)
+        stats = (out.reward, term, trunc)
+        return carry, emitted, stats
+
+    if emit == "chunk":
+        def rollout(params, carry, base_key, tick0, eps):
+            ticks = tick0 + jnp.arange(K)
+
+            def body(c, t):
+                c, emitted, (r, te, tr) = one_tick(params, eps,
+                                                   base_key, c, t)
+                return c, RolloutChunk(step_reward=r, step_terminal=te,
+                                       step_truncated=tr, **emitted)
+
+            carry, chunk = jax.lax.scan(body, carry, ticks)
+            return carry, chunk
+
+        return jax.jit(rollout, donate_argnums=(1,))
+
+    def rollout(params, carry, ring_state, base_key, tick0, eps):
+        ticks = tick0 + jnp.arange(K)
+        capacity = ring_state.reward.shape[0]
+
+        def body(cs, t):
+            c, ring, fed = cs
+            c, e, (r, te, tr) = one_tick(params, eps, base_key, c, t)
+            ring, wrote = ring_write_masked(
+                ring, Transition(
+                    state0=e["state0"], action=e["action"],
+                    reward=e["reward"], gamma_n=e["gamma_n"],
+                    state1=e["state1"], terminal1=e["terminal1"]),
+                e["valid"], capacity)
+            return (c, ring, fed + wrote), (r, te, tr)
+
+        (carry, ring_state, fed), (r, te, tr) = jax.lax.scan(
+            body, (carry, ring_state, jnp.int32(0)), ticks)
+        return carry, ring_state, RolloutStats(
+            step_reward=r, step_terminal=te, step_truncated=tr, fed=fed)
+
+    return jax.jit(rollout, donate_argnums=(1, 2))
+
+
+def rollout_priorities(chunk_np: dict, enabled: bool):
+    """Actor-side PER initial priorities off a fetched chunk's columns:
+    |R + gamma_n * maxQ(s_end) - q_sel| with the bootstrap term zeroed
+    on true terminals (the q_boot column already is) — the exact
+    estimate the host actor's pending-queue computes
+    (agents/actor.py).  Rows with ``prio_ok`` False (truncated closes)
+    get None: the host path feeds those at new-sample max priority.
+    Returns an object-dtype convenience: (N,) array of float-or-None.
+    """
+    if not enabled:
+        return None
+    f8 = lambda k: np.asarray(chunk_np[k], np.float64)
+    # f64 like the host actor's python-float arithmetic, so the two
+    # paths assign identical priorities to identical transitions
+    pr = np.abs(f8("reward") + f8("gamma_n") * (1.0 - f8("terminal1"))
+                * f8("q_boot") - f8("q_sel"))
+    out = np.empty(pr.shape, dtype=object)
+    ok = np.asarray(chunk_np["prio_ok"], bool)
+    out[ok] = pr[ok].astype(np.float64)
+    out[~ok] = None
+    return out
 
 
 def build_greedy_act(apply_fn: Callable) -> Callable:

@@ -1158,9 +1158,9 @@ class RemoteClock:
         # as GlobalClock.bump_progress
         self.progress = None
 
-    def bump_progress(self, label: str) -> None:
+    def bump_progress(self, label: str, n: int = 1) -> None:
         if self.progress is not None:
-            self.progress.bump(label)
+            self.progress.bump(label, n)
 
     @property
     def stop(self) -> threading.Event:

@@ -88,8 +88,10 @@ def peak_flops_of(device) -> Optional[float]:
 
 
 def flops_of_compiled(compiled) -> Optional[float]:
-    """Per-call FLOPs off an AOT-compiled executable's XLA cost
-    analysis.  XLA counts a scan/while body ONCE (verified in bench.py
+    """Per-call FLOPs off a ``cost_analysis()``-bearing jax stage — an
+    AOT-compiled executable, or a ``Lowered`` program where the
+    backend supports pre-compile analysis (same figures, no XLA
+    compile).  XLA counts a scan/while body ONCE (verified in bench.py
     micro across K=1/8/64), so for a fused multi-update program the
     figure is per-UPDATE, not per-dispatch.  Best-effort: backends
     without cost analysis return None."""
@@ -369,6 +371,7 @@ class PerfMonitor:
         self.params = resolve(params)
         self.enabled = self.params.enabled
         self.flops_per_update: Optional[float] = None
+        self.flops_per_frame: Optional[float] = None
         self._peak: Optional[float] = None
         self._peak_resolved = False
         self.retraces = RetraceDetector()
@@ -400,6 +403,34 @@ class PerfMonitor:
                   f"mfu reporting disabled", flush=True)
             self.flops_per_update = None
         return self.flops_per_update
+
+    def capture_frame_flops(self, lower_thunk: Callable[[], Any],
+                            frames_per_call: int) -> Optional[float]:
+        """Frame-denominated twin of ``capture_flops`` for the actor
+        plane: keep the fused rollout's per-env-frame FLOPs, so the
+        device actor's MFU rides the SAME frames counter the
+        env-frames/s rate uses (ISSUE 7: the rollout program's
+        utilization is a live-plane read, not a bench artifact).
+
+        Cost analysis is read off the LOWERED program when the backend
+        supports it (lowering is tracing-only — no XLA compile), so
+        the rollout is not compiled twice at actor startup (once for
+        flops, once for the first real dispatch); backends without
+        lowered-stage analysis fall back to the AOT compile."""
+        if not self.enabled:
+            return None
+        try:
+            lowered = lower_thunk()
+            total = flops_of_compiled(lowered)
+            if total is None:
+                total = flops_of_compiled(lowered.compile())
+            self.flops_per_frame = (total / frames_per_call
+                                    if total else None)
+        except Exception as e:  # noqa: BLE001
+            print(f"[perf] {self.name}: frame-flops capture failed "
+                  f"({e!r}); rollout mfu reporting disabled", flush=True)
+            self.flops_per_frame = None
+        return self.flops_per_frame
 
     def register_jit(self, name: str,
                      size_fn: Optional[Callable[[], Optional[int]]]) -> None:
@@ -462,7 +493,14 @@ class PerfMonitor:
                     if peak:
                         out[f"{self.prefix}/mfu"] = achieved / peak
             if self._frames or d_fr:
-                out[f"{self.prefix}/env_frames_per_s"] = d_fr / dt
+                fps = d_fr / dt
+                out[f"{self.prefix}/env_frames_per_s"] = fps
+                if self.flops_per_frame:
+                    achieved = fps * self.flops_per_frame
+                    out[f"{self.prefix}/achieved_flops_per_s"] = achieved
+                    peak = self._peak_flops()
+                    if peak:
+                        out[f"{self.prefix}/mfu"] = achieved / peak
         if self.flops_per_update and not self._flops_reported:
             self._flops_reported = True
             out[f"{self.prefix}/flops_per_update"] = self.flops_per_update
@@ -504,6 +542,8 @@ class PerfMonitor:
         snap["frames_total"] = float(self._frames)
         if self.flops_per_update:
             snap[f"{self.prefix}/flops_per_update"] = self.flops_per_update
+        if self.flops_per_frame:
+            snap[f"{self.prefix}/flops_per_frame"] = self.flops_per_frame
         return snap
 
 

@@ -800,3 +800,114 @@ class TestPhaseBreakdownPlot:
                  "MPLBACKEND": "Agg"})
         assert proc.returncode != 0
         assert "time_*_total_ms" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: the fused device rollout on the perf plane — retrace
+# fire/silent drill + transfer-audit coverage of the new hot program,
+# and the fleet_top per-actor panel line
+# ---------------------------------------------------------------------------
+
+class TestDeviceRolloutPerfPlane:
+    @pytest.fixture(scope="class")
+    def rollout(self):
+        """A tiny fused rollout (linear policy, 2 device Pong envs)
+        shared by the drills — the registration surface is identical
+        to the production CNN one."""
+        import jax.numpy as jnp
+
+        from pytorch_distributed_tpu.envs.device_env import (
+            build_device_env,
+        )
+        from pytorch_distributed_tpu.models.policies import (
+            build_fused_rollout, init_rollout_carry,
+        )
+
+        opt = build_options(4)
+        env = build_device_env(opt.env_params, 0, 2)
+        dim = int(np.prod(env.state_shape))
+        w = jnp.asarray(np.zeros((dim, 6), np.float32))
+
+        def apply_fn(params, obs):
+            return obs.reshape((obs.shape[0], -1)).astype(
+                jnp.float32) @ params
+
+        roll = build_fused_rollout(apply_fn, env, nstep=2, gamma=0.99,
+                                   rollout_ticks=2, emit="chunk")
+        return dict(roll=roll, w=w, env=env,
+                    carry=lambda: init_rollout_carry(env, 2))
+
+    def test_rollout_retrace_drill_silent_then_fires(self, rollout):
+        """The registered rollout program must stay silent across
+        same-shape dispatches (the production stream: tick0 is traced,
+        so consecutive dispatches share one compile) and FIRE when a
+        dtype leak forces a recompile."""
+        import jax.numpy as jnp
+
+        roll, w = rollout["roll"], rollout["w"]
+        m = perf.PerfMonitor("actor-drill", PerfParams(
+            enabled=True, memory_watermarks=False), prefix="actor")
+        m.register_jit("device_rollout", roll._cache_size)
+        key = jnp.asarray(np.zeros(2, np.uint32))
+        eps = jnp.zeros((2,), jnp.float32)
+        carry, _ = roll(w, rollout["carry"](), key, jnp.int32(0), eps)
+        m.note_frames(4)
+        m.drain(now=1.0)  # warmup mark
+        for d in range(1, 4):  # production stream: traced tick0 only
+            carry, _ = roll(w, carry, key, jnp.int32(d * 2), eps)
+        m.note_frames(12)
+        out = m.drain(now=2.0)
+        assert out["perf/actor/retraces"] == 0.0
+        # the leak class the detector exists for: a raw python int
+        # tick0 (weak-typed i32) instead of the driver's device-
+        # resident strong i32 — new aval, fresh trace
+        carry, _ = roll(w, carry, key, 8, eps)
+        m.note_frames(4)
+        out = m.drain(now=3.0)
+        assert out["perf/actor/retraces"] == 1.0
+
+    def test_rollout_transfer_audit_clean_and_flagged(self, rollout):
+        """The device actor's dispatch is transfer-free by
+        construction (device-resident key/eps/tick0/carry): the audit
+        must pass it clean, and must flag + attribute + survive a
+        smuggled host array."""
+        import jax.numpy as jnp
+
+        roll, w = rollout["roll"], rollout["w"]
+        aud = perf.TransferAudit()
+        key = jnp.asarray(np.zeros(2, np.uint32))
+        eps = jnp.zeros((2,), jnp.float32)
+        tick0 = jnp.int32(0)
+        carry, _ = roll(w, rollout["carry"](), key, tick0, eps)
+        carry, _ = aud.run(roll, w, carry, key, tick0 + 2, eps)
+        assert aud.total == 0
+        # a host numpy eps is an implicit H2D on the audited path
+        carry, chunk = aud.run(roll, w, carry, key, tick0 + 4,
+                               np.zeros(2, np.float32))
+        assert aud.total == 1 and len(aud.sites) == 1
+        assert chunk.valid.shape == (2, 2)
+
+    def test_fleet_top_renders_per_actor_backend_line(self):
+        """ISSUE 7 satellite: the STATUS ``actors`` block (per-slot env
+        frames/s + active backend) renders in the panel and survives
+        --json serialization."""
+        from tools import fleet_top
+
+        status = {
+            "wall": 0.0, "learner_step": 10, "actor_step": 400,
+            "slots": {},
+            "actors": {
+                "0": {"env_frames_per_sec": 512.5, "backend": "device"},
+                "1": {"env_frames_per_sec": 100.0, "backend": "device"},
+            },
+        }
+        line = fleet_top.actor_line(status)
+        assert "actors[device]" in line
+        assert "a0 512.5 f/s" in line and "a1 100 f/s" in line
+        panel = fleet_top.render(status)
+        assert "actors[device]" in panel
+        json.loads(json.dumps(status))  # --json path serializes
+        # mixed backends are labelled, absent block renders nothing
+        status["actors"]["1"]["backend"] = "pipelined"
+        assert "actors[mixed]" in fleet_top.actor_line(status)
+        assert fleet_top.actor_line({"slots": {}}) is None

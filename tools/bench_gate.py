@@ -66,7 +66,12 @@ SPECS: List[Tuple[str, str, str]] = [
     ("e2e_paced_updates_per_sec", "higher", "e2e"),
     ("health_overhead.health_overhead_frac", "lower_abs", "overhead"),
     ("perf_overhead.perf_overhead_frac", "lower_abs", "overhead"),
+    ("device_env.host_frames_per_sec", "higher", "device_env"),
+    ("device_env.device_frames_per_sec", "higher", "device_env"),
+    ("device_env.fused_frames_per_sec", "higher", "device_env"),
+    ("device_env.speedup_vs_host", "higher", "device_env"),
     ("smoke.updates_per_sec", "higher", "smoke"),
+    ("smoke.device_env_frames_per_sec", "higher", "smoke"),
 ]
 
 # Per-section default tolerance.  Relative for rates (sized to the
@@ -80,6 +85,10 @@ DEFAULT_TOL: Dict[str, float] = {
     "actor": 0.25,
     "e2e": 0.30,
     "overhead": 0.02,   # absolute band on a <2%-by-contract fraction
+    # env-fleet rates: XLA dispatch + host scheduling noise on small
+    # hosts; the speedup ratio divides out most machine noise but
+    # keeps the same band for simplicity
+    "device_env": 0.30,
     "smoke": 0.40,      # CPU-host scheduling noise is large at small K
 }
 

@@ -125,6 +125,24 @@ def perf_line(status: dict,
     return "  perf: " + " · ".join(bits) if bits else None
 
 
+def actor_line(status: dict) -> Optional[str]:
+    """Per-actor slot line: env frames/s attributed to each LOCAL
+    actor slot plus the schedule it actually runs (device / pipelined
+    / batched / inline, post-downgrade) — the ISSUE-7 read of whether
+    the fleet's actor plane is on the device env fleet and which slot
+    is lagging.  Remote actor hosts report through their own metrics
+    streams (--metrics overlay), not this block."""
+    actors = status.get("actors") or {}
+    if not actors:
+        return None
+    backends = {a.get("backend", "?") for a in actors.values()}
+    backend = backends.pop() if len(backends) == 1 else "mixed"
+    bits = [f"a{slot} {info.get('env_frames_per_sec', 0.0):g} f/s"
+            for slot, info in sorted(actors.items(),
+                                     key=lambda kv: int(kv[0]))]
+    return f"  actors[{backend}]: " + " · ".join(bits)
+
+
 def render(status: dict,
            metrics_latest: Optional[Dict[str, float]] = None) -> str:
     """One snapshot as a plain-text panel (no curses: works in any
@@ -157,6 +175,9 @@ def render(status: dict,
     pline = perf_line(status, metrics_latest)
     if pline:
         lines.append(pline)
+    aline = actor_line(status)
+    if aline:
+        lines.append(aline)
     # health sentinel (utils/health.py): guard skips / rollbacks / hang
     # kills from the learner host, quarantine counts split by boundary —
     # the gateway's per-slot counts name WHICH remote actor is poisoning
