@@ -748,7 +748,12 @@ def _mlp_fused_program(B: int, K: int):
                         state_dtype=np.float32,
                         action_dtype=spec.action_dtype)
     C = 64
-    for _ in range(ring.capacity // C):
+    for c in range(ring.capacity // C):
+        # rows carry provenance (two fake actors, version 1) so the
+        # provenance-overhead bench's telemetry leg computes on REAL
+        # stamps, not an all-sentinel fast path
+        prov = np.stack([np.array([j % 2, j % 8, 1, c * C + j],
+                                  np.int32) for j in range(C)])
         ring.feed_chunk(Transition(
             state0=rng.normal(size=(C, *spec.state_shape)).astype(
                 np.float32),
@@ -757,7 +762,8 @@ def _mlp_fused_program(B: int, K: int):
             gamma_n=np.full(C, 0.99 ** 5, np.float32),
             state1=rng.normal(size=(C, *spec.state_shape)).astype(
                 np.float32),
-            terminal1=(rng.random(C) < 0.1).astype(np.float32)))
+            terminal1=(rng.random(C) < 0.1).astype(np.float32),
+            prov=prov))
     fused = build_uniform_fused_step(step, B, steps_per_call=K,
                                      donate=False)
     return fused, state, ring
@@ -889,6 +895,170 @@ def bench_perf_overhead(windows: int = 6,
     }
     print(f"[bench_perf_overhead] {out}", file=sys.stderr, flush=True)
     return {"perf_overhead": out}
+
+
+def bench_provenance_overhead(windows: int = 5,
+                              smoke: bool = False) -> dict:
+    """Provenance-column cost on the fused hot paths (ISSUE 8
+    acceptance): the data-plane X-ray must be <2% on both fused
+    programs, enforced by the bench gate's absolute overhead band.
+
+    Two legs, each instrumented-vs-bare on the SAME compiled jit:
+
+    - **rollout** — the fused device rollout (emit="replay", linear
+      policy: engine cost, not CNN FLOPs) dispatched WITH a provenance
+      stamp (the (3,) int32 arg scattered as 4 extra int32 columns per
+      emitted row) vs WITHOUT (columns written as the -1 sentinel —
+      the write itself is schema-resident either way, so this measures
+      the stamp's broadcast + the real column traffic).
+    - **learner** — the fused learner step loop with the learner's
+      stats-cadence telemetry running (one 256-row provenance gather
+      D2H + the staleness/age/share numpy math + histogram rows per
+      window, exactly agents/learner.py's wiring) vs bare.
+
+    ``smoke=True`` shrinks N/windows to seconds-scale for CI; the
+    measurement logic is identical.  Overhead fracs are clamped at 0 —
+    negative overhead is window noise on a small host."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.envs.device_env import build_device_env
+    from pytorch_distributed_tpu.memory.device_replay import (
+        DeviceReplay, provenance_sample,
+    )
+    from pytorch_distributed_tpu.models.policies import (
+        build_fused_rollout, init_rollout_carry,
+    )
+    from pytorch_distributed_tpu.utils import health as health_mod
+    from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+
+    N, K = (32, 8) if smoke else (256, 8)
+    opt = build_options(4, visualize=False)
+    env = build_device_env(opt.env_params, 0, N)
+    apply_fn, params = _device_env_linear_policy(env.state_shape)
+    roll = build_fused_rollout(apply_fn, env, nstep=5, gamma=0.99,
+                               rollout_ticks=K, emit="replay")
+    eps = jnp.full((N,), 0.1, jnp.float32)
+    key = jnp.asarray(jax.random.PRNGKey(0))
+    prov3 = jnp.asarray(np.array([0, 1, 0], np.int32))
+
+    def rollout_rate(with_prov: bool) -> float:
+        import gc
+
+        gc.collect()
+        # fresh ring per leg: the rollout DONATES the ring state, so a
+        # leg must never reuse the other leg's consumed buffers
+        ring = DeviceReplay(capacity=max(2 * K * N, 2048),
+                            state_shape=env.state_shape,
+                            state_dtype=np.uint8)
+        box = [init_rollout_carry(env, 5), ring.state, jnp.int32(0)]
+
+        def tick():
+            carry, rs, tick0 = box
+            if with_prov:
+                carry, rs, stats = roll(params, carry, rs, key, tick0,
+                                        eps, prov3)
+            else:
+                carry, rs, stats = roll(params, carry, rs, key, tick0,
+                                        eps)
+            int(jax.device_get(stats.fed))  # fetch-bounded
+            box[:] = [carry, rs, tick0 + K]
+
+        tick()  # warm/compile
+        ticks = max(1, (512 if smoke else 2048) // (K * N))
+        rates = []
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(ticks):
+                tick()
+            rates.append(N * K * ticks / (time.perf_counter() - t0))
+        return float(np.median(rates))
+
+    roll_bare = rollout_rate(False)
+    roll_prov = rollout_rate(True)
+    roll_frac = ((roll_bare - roll_prov) / roll_bare
+                 if roll_bare > 0 else None)
+
+    # ---- learner leg: fused step loop ± the stats-cadence telemetry ----
+    B, LK = (32, 8)
+    fused, state0, lring = _mlp_fused_program(B, LK)
+    lkey = jax.random.PRNGKey(0)
+
+    def keymat():
+        nonlocal lkey
+        lkey, sub = jax.random.split(lkey)
+        return jax.random.split(sub, LK)
+
+    compiled = fused.lower(state0, lring.state, keymat()).compile()
+    prov_jit = jax.jit(provenance_sample, static_argnames="n")
+    tel_key = jax.random.PRNGKey(7)
+
+    def learner_rate(instrumented: bool) -> float:
+        state = state0
+        writer = None
+        if instrumented:
+            writer = MetricsWriter(
+                tempfile.mkdtemp(prefix="bench_prov_"),
+                enable_tensorboard=False, role="learner")
+        for _ in range(5):
+            state, metrics = compiled(state, lring.state, keymat())
+        float(jax.device_get(metrics["learner/critic_loss"]))
+        iters = max((128 if smoke else 512) // LK, 2)
+        rates, mstep = [], 0
+        for _ in range(windows):
+            keysets = [keymat() for _ in range(iters)]
+            jax.block_until_ready(keysets[-1])
+            t0 = time.perf_counter()
+            for ks in keysets:
+                state, metrics = compiled(state, lring.state, ks)
+            if instrumented:
+                mstep += iters * LK
+                pr, _fill = prov_jit(
+                    lring.state, jax.random.fold_in(tel_key, mstep),
+                    n=256)
+                # the EXACT production computation (agents/learner.py
+                # calls the same helper) — the bench must not drift
+                # from what the learner actually pays per cadence
+                ds = health_mod.provenance_stats(np.asarray(pr), 1,
+                                                 mstep)
+                if ds is not None:
+                    writer.histogram("learner/staleness",
+                                     ds["staleness"].tolist(),
+                                     step=mstep)
+                    writer.histogram("learner/sample_age",
+                                     ds["age"].tolist(), step=mstep)
+                    writer.histogram("replay/actor_share",
+                                     ds["shares"].tolist(), step=mstep)
+            float(jax.device_get(metrics["learner/critic_loss"]))
+            rates.append(iters * LK / (time.perf_counter() - t0))
+        if writer is not None:
+            writer.close()
+        return float(np.median(rates))
+
+    learn_bare = learner_rate(False)
+    learn_instr = learner_rate(True)
+    learn_frac = ((learn_bare - learn_instr) / learn_bare
+                  if learn_bare > 0 else None)
+    fracs = [f for f in (roll_frac, learn_frac) if f is not None]
+    out = {
+        "rollout_frames_per_sec_bare": round(roll_bare, 1),
+        "rollout_frames_per_sec_prov": round(roll_prov, 1),
+        "rollout_overhead_frac": (round(max(roll_frac, 0.0), 4)
+                                  if roll_frac is not None else None),
+        "learner_updates_per_sec_bare": round(learn_bare, 2),
+        "learner_updates_per_sec_instr": round(learn_instr, 2),
+        "learner_overhead_frac": (round(max(learn_frac, 0.0), 4)
+                                  if learn_frac is not None else None),
+        # the gate's single number: worst of the two fused paths
+        "provenance_overhead_frac": (round(max(max(fracs), 0.0), 4)
+                                     if fracs else None),
+        "rollout_envs": N,
+        "geometry": "smoke" if smoke else "full",
+    }
+    print(f"[bench_provenance_overhead] {out}", file=sys.stderr,
+          flush=True)
+    return {"provenance_overhead": out}
 
 
 def bench_smoke(updates: int = 384) -> dict:
@@ -1370,7 +1540,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("micro", "e2e", "both", "families",
                                        "sampler", "act", "actor",
-                                       "health", "perf", "device_env"),
+                                       "health", "perf", "device_env",
+                                       "provenance"),
                     default="both")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale CPU-safe bench (the dqn-mlp "
@@ -1433,6 +1604,8 @@ def main() -> None:
         result.update(bench_health_overhead())
     if args.mode in ("both", "perf"):
         result.update(bench_perf_overhead())
+    if args.mode in ("both", "provenance"):
+        result.update(bench_provenance_overhead())
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))

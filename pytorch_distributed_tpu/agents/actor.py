@@ -65,6 +65,7 @@ from pytorch_distributed_tpu.agents.param_store import (
     ParamPrefetcher, ParamStore, make_flattener,
 )
 from pytorch_distributed_tpu.ops.nstep import NStepAssembler
+from pytorch_distributed_tpu.utils.experience import make_prov
 from pytorch_distributed_tpu.utils.random_process import (
     OrnsteinUhlenbeckProcess,
 )
@@ -144,6 +145,17 @@ class _ActorHarness:
             # teardown join
             memory.set_stop(clock.stop)
 
+        # data-plane provenance (ISSUE 8): every transition this actor
+        # emits carries (actor_id, env_slot, param_version, birth_step)
+        # minted at action time.  ``_feed_version`` snapshots the version
+        # that actually SELECTED this tick's actions — tick_sync captures
+        # it BEFORE running the swap cadence, so the swap tick's rows
+        # still carry the acting version; ``_birth_step`` is the global
+        # learner step the actor observed (sample age is then a
+        # learner-step subtraction on the learner side, no clock math).
+        self._feed_version = getattr(self, "version", 0)
+        self._birth_step = int(clock.learner_step.value)
+
         N = self.num_envs
         self.assemblers: List[NStepAssembler] = [
             NStepAssembler(self.ap.nstep, self.ap.gamma) for _ in range(N)]
@@ -214,6 +226,8 @@ class _ActorHarness:
         satellite)."""
         N = self.num_envs
         self.env_steps += N
+        self._feed_version = getattr(self, "version", 0)
+        self._birth_step = int(self.clock.learner_step.value)
         self.perf.note_frames(N)  # one int add; no-op when disabled
         self.clock.add_actor_steps(N)  # reference dqn_actor.py:166-167
         self._bump_progress(self._progress_label)  # watchdog liveness
@@ -246,7 +260,9 @@ class _ActorHarness:
                 self._q_hist[j].append(float(q_sel[j]))
             transitions = self.assemblers[j].feed(
                 self._obs[j], actions[j], float(rewards[j]), true_next,
-                bool(terminals[j]), truncated=truncated)
+                bool(terminals[j]), truncated=truncated,
+                prov=make_prov(self.process_ind, j, self._feed_version,
+                               self._birth_step))
             if self.per_priorities:
                 self._feed_with_priorities(j, transitions,
                                            bool(terminals[j]), truncated)
@@ -637,6 +653,13 @@ def _drive_device_actor_loop(h: _ActorHarness, clock: GlobalClock,
         ch = jax.device_get(chunk)  # the dispatch's ONE device->host copy
         timer.add("emit", time.perf_counter() - t0)
         # ---- per-dispatch cadence (the vector ticks' tick_sync) ----
+        # provenance stamps quantize to the dispatch: the chunk's rows
+        # carry the version that acted THIS dispatch (captured before
+        # the swap cadence below) and the learner step observed at
+        # fetch — windows opened in the previous dispatch inherit the
+        # current stamp, a documented <=K-tick quantization
+        feed_version = h.version
+        birth_step = int(h.clock.learner_step.value)
         h.env_steps += K * N
         h.perf.note_frames(K * N)
         h.clock.add_actor_steps(K * N)
@@ -672,7 +695,9 @@ def _drive_device_actor_loop(h: _ActorHarness, clock: GlobalClock,
                         reward=ch.reward[k, j],
                         gamma_n=ch.gamma_n[k, j],
                         state1=ch.state1[k, j],
-                        terminal1=ch.terminal1[k, j])
+                        terminal1=ch.terminal1[k, j],
+                        prov=make_prov(h.process_ind, j, feed_version,
+                                       birth_step))
                     h.memory.feed(t, prio[k][j] if prio is not None
                                   else None)
                 # episode accounting off the per-tick env stats

@@ -327,6 +327,31 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             def device_step(keys):  # noqa: F811 - deliberate rebind
                 return perf_mon.audit.run(_unaudited_step, keys)
 
+        # data-plane telemetry programs (ISSUE 8): a bounded provenance
+        # gather and — for the PER ring — the in-jit priority X-ray;
+        # each is ONE small D2H on the stats cadence, never per step
+        from pytorch_distributed_tpu.memory.device_replay import (
+            provenance_sample,
+        )
+
+        _prov_sample = (jax.jit(provenance_sample, static_argnames="n")
+                        if getattr(replay.state, "prov", None) is not None
+                        else None)
+        _xray_dev = None
+        if getattr(replay.state, "priority", None) is not None:
+            from pytorch_distributed_tpu.memory.device_per import (
+                priority_xray_device,
+            )
+
+            _xray_dev = jax.jit(priority_xray_device,
+                                static_argnames="bins")
+        # telemetry's own key stream, decoupled from the sampling
+        # stream by a fold — never a draw from device_key's chain
+        _tel_key = jax.random.fold_in(
+            jax.random.PRNGKey(np_rng(opt.seed, "learner",
+                                      process_ind).integers(2 ** 31)),
+            0x7e1)
+
         device_key = jax.random.PRNGKey(
             np_rng(opt.seed, "learner", process_ind).integers(2 ** 31))
         saved_key = (epoch.extras.get("rng", {}).get("learner_device")
@@ -451,12 +476,14 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     hp = health.resolve(opt.health_params)
     detector = health.AnomalyDetector(zmax=hp.anomaly_zmax,
                                       grad_spike=hp.grad_spike,
-                                      threshold=hp.anomaly_threshold)
+                                      threshold=hp.anomaly_threshold,
+                                      ess_floor=hp.ess_floor)
     recorder = flight_recorder.get_recorder("learner")
     _linj = FaultInjector.from_env("learner")
     _poison = [False]   # a pending poison_grad verb (next host batch)
     _win_skips = [0]    # exact skip count this stats window (host paths)
     _last_td = [None]   # mean |TD| of the last applied host-PER step
+    _last_idx = [None]  # last sampled host-batch indices (provenance)
     _rb = {"used": 0, "before": None}  # rollback budget + ladder position
 
     def _fatal_divergence(msg: str) -> None:
@@ -584,6 +611,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                     tracer.span("sample",
                                 trace_id=tracing.current_trace()):
                 batch = memory.sample(ap.batch_size, rng)
+            _last_idx[0] = np.asarray(batch.index)
             if _poison[0]:
                 # poison_grad drill: a non-finite loss injected into
                 # THIS update — the in-jit guard must skip it with
@@ -661,24 +689,86 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             _win_skips[0] = 0
             if skipped_w:
                 clock.add_skipped_steps(int(round(skipped_w)))
-            # PER extras for the detector: |TD| scale from the last
-            # applied step (host PER syncs it anyway) and the sum
-            # tree's total priority mass — a collapse to ~0 means every
-            # sample draws the same handful of rows.  Device rings keep
-            # their mass on-chip; fetching it would be a host sync, so
-            # those paths lean on the loss/grad/skip signals instead.
-            pmass, prows = None, 0
-            per_mem = getattr(memory, "memory", None) if is_per else None
-            if per_mem is not None and hasattr(per_mem, "sum_tree"):
-                pmass = float(per_mem.sum_tree.total())
-                prows = int(per_mem.size)
+            # ---- data-plane X-ray (ISSUE 8): provenance of what the
+            # learner is actually consuming + the PER priority
+            # distribution, exported on this cadence and fed to the
+            # detector.  Host paths read their sidecars directly; the
+            # device paths pay ONE bounded D2H each (a 256-row
+            # provenance gather / the in-jit bucket histogram).
+            prov = None
+            prov_fn = getattr(memory, "provenance_of", None)
+            if prov_fn is not None and _last_idx[0] is not None:
+                prov = prov_fn(_last_idx[0])
+                prov = None if prov is None else np.asarray(prov)
+            elif on_device and _prov_sample is not None:
+                pr_dev, _ = _prov_sample(
+                    replay.state, jax.random.fold_in(_tel_key, lstep),
+                    n=256)
+                prov = np.asarray(pr_dev)
+            cur_version = int(getattr(param_store, "version", 0) or 0)
+            ds = (health.provenance_stats(prov, cur_version, lstep)
+                  if prov is not None else None)
+            if ds is not None:
+                timing_writer.histogram("learner/staleness",
+                                        ds["staleness"].tolist(),
+                                        step=lstep)
+                timing_writer.histogram("learner/sample_age",
+                                        ds["age"].tolist(), step=lstep)
+                timing_writer.histogram("replay/actor_share",
+                                        ds["shares"].tolist(),
+                                        step=lstep)
+                perf_mon.set_gauge("data/staleness_p50",
+                                   float(np.median(ds["staleness"])))
+                perf_mon.set_gauge("data/sample_age_p95",
+                                   float(np.percentile(ds["age"], 95)))
+                perf_mon.set_gauge("data/top_actor_share",
+                                   float(ds["shares"].max()))
+            xray = None
+            # mass/rows kept SEPARATE from the X-ray: an all-zero leaf
+            # set yields xray=None, and the detector must still see
+            # (mass ~0, rows > 0) — the degenerate collapse the signal
+            # was originally built for
+            p_mass, p_rows = None, 0
+            leaves_fn = getattr(memory, "priority_leaves", None)
+            leaves = leaves_fn() if leaves_fn is not None else None
+            if leaves is not None and len(leaves):
+                p_mass = float(np.sum(leaves))
+                p_rows = int(len(leaves))
+                xray = health.priority_xray(leaves)
+            elif on_device and _xray_dev is not None:
+                counts, ess, rows_d, mass = jax.device_get(
+                    _xray_dev(replay.state))
+                rows_d = int(rows_d)
+                p_mass, p_rows = float(mass), rows_d
+                if rows_d:
+                    xray = {"rows": rows_d, "mass": float(mass),
+                            "ess": float(ess),
+                            "ess_frac": float(ess) / rows_d,
+                            "counts": np.asarray(counts),
+                            "log10_lo": health.PRIORITY_XRAY_LOG10_LO,
+                            "log10_hi": health.PRIORITY_XRAY_LOG10_HI}
+            if xray is not None:
+                timing_writer.bucket_histogram(
+                    "replay/priority", xray["counts"],
+                    log10_lo=xray["log10_lo"], log10_hi=xray["log10_hi"],
+                    step=lstep,
+                    extra={"ess": xray["ess"],
+                           "ess_frac": xray["ess_frac"],
+                           "mass": xray["mass"], "rows": xray["rows"]})
+                timing_writer.scalars({
+                    "replay/priority_ess": xray["ess"],
+                    "replay/priority_ess_frac": xray["ess_frac"],
+                }, step=lstep)
+                perf_mon.set_gauge("data/priority_ess",
+                                   xray["ess_frac"])
             anomalies = detector.observe(
                 loss=vals.get("learner/critic_loss"),
                 grad_norm=vals.get("learner/grad_norm"),
                 td_mean=_last_td[0],
-                priority_mass=pmass,
-                replay_rows=prows,
-                skipped=skipped_w)
+                priority_mass=p_mass,
+                replay_rows=p_rows,
+                skipped=skipped_w,
+                priority_ess=xray["ess_frac"] if xray else None)
             if anomalies:
                 recorder.record("anomaly", step=lstep, kinds=anomalies,
                                 streak=detector.streak)

@@ -40,6 +40,7 @@ from pytorch_distributed_tpu.agents.actor import (
 from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
 from pytorch_distributed_tpu.agents.param_store import ParamStore
 from pytorch_distributed_tpu.memory.sequence_replay import SegmentBuilder
+from pytorch_distributed_tpu.utils.experience import make_prov
 from pytorch_distributed_tpu.utils.helpers import pin_to_cpu
 from pytorch_distributed_tpu.utils.rngs import process_key
 
@@ -84,7 +85,9 @@ class _RecurrentHarness(_ActorHarness):
                     # bootstrap through (not a death) — same distinction
                     # the n-step assembler draws for feed()
                     bool(terminals[j]) and not truncated, true_next,
-                    per_env_carry, episode_end=bool(terminals[j])):
+                    per_env_carry, episode_end=bool(terminals[j]),
+                    prov=make_prov(self.process_ind, j,
+                                   self._feed_version, self._birth_step)):
                 self.memory.feed(seg, None)
             self.episode_steps[j] += 1
             self.episode_reward[j] += float(rewards[j])

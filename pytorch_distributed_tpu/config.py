@@ -346,6 +346,12 @@ class HealthParams:
     anomaly_zmax: float = 8.0
     grad_spike: float = 100.0
     anomaly_threshold: int = 3
+    # Priority-distribution floor (ISSUE 8): the detector's
+    # ``priority_collapse`` signal fires when the PER leaves' normalized
+    # effective sample size (ESS / rows, from the priority X-ray) drops
+    # under this — sampling has concentrated onto ~ess_floor * rows
+    # rows even though total mass still looks healthy.
+    ess_floor: float = 0.02
     # Automatic in-process rollback to the last good checkpoint epoch on
     # sustained divergence (needs committed epochs: checkpoint_freq > 0
     # or a preemption save).  ``max_rollbacks`` bounds the budget before

@@ -311,7 +311,7 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int,
     from pytorch_distributed_tpu.utils import flight_recorder
     from pytorch_distributed_tpu.utils.supervision import EXIT_DISCONNECTED
 
-    flight_recorder.configure(opt.log_dir)
+    flight_recorder.configure(opt.log_dir, run_id=opt.refs)
     recorder = flight_recorder.get_recorder(f"actor-{process_ind}")
     host, port = coordinator.rsplit(":", 1)
     recorder.record("session-start", coordinator=coordinator)
@@ -453,7 +453,8 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
         EXIT_HUNG, RestartBudget, describe_exit,
     )
 
-    flight_recorder.configure(opt.log_dir, export_env=True)
+    flight_recorder.configure(opt.log_dir, export_env=True,
+                              run_id=opt.refs)
     host_recorder = flight_recorder.get_recorder("fleet-host")
     budget = RestartBudget(max_restarts=max_restarts, backoff=True)
     for ind in workers:

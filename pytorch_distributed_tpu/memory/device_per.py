@@ -29,7 +29,9 @@ import numpy as np
 from pytorch_distributed_tpu.memory.device_replay import (
     DeviceReplay, ring_write, round_capacity,
 )
-from pytorch_distributed_tpu.utils.experience import Batch, Transition
+from pytorch_distributed_tpu.utils.experience import (
+    REPLAY_FIELDS, Batch, Transition,
+)
 
 
 class PerReplayState(NamedTuple):
@@ -39,6 +41,7 @@ class PerReplayState(NamedTuple):
     gamma_n: jax.Array
     state1: jax.Array
     terminal1: jax.Array
+    prov: jax.Array          # (N, 4) int32 provenance columns; -1 = unknown
     priority: jax.Array      # (N,) f32, pre-exponentiated p^alpha; 0 = empty
     max_priority: jax.Array  # () f32, running max of p^alpha
     pos: jax.Array           # int32 write cursor
@@ -88,6 +91,36 @@ def per_sample(state: PerReplayState, key: jax.Array, batch_size: int,
         weight=weights.astype(jnp.float32),
         index=idx,
     )
+
+
+PRIORITY_XRAY_LOG10_LO = -6.0   # log10 bucket floor (p^alpha units)
+PRIORITY_XRAY_LOG10_HI = 3.0    # log10 bucket ceiling
+
+
+def priority_xray_device(state: PerReplayState, bins: int = 16):
+    """In-jit priority X-ray over the HBM PER leaves (ISSUE 8): a
+    log10-bucketed histogram of the non-empty leaves plus the
+    effective sample size ``(sum p)^2 / sum p^2`` — the distribution
+    shape the AnomalyDetector needs instead of a bare mass ratio, at
+    the cost of ONE small D2H (bins + 3 scalars) per stats cadence.
+    Bucket edges are the fixed [10^-6, 10^3) decade grid shared with
+    the host X-ray (utils/health.priority_xray), so ``fleet_top``
+    renders either identically.  Jit with ``static_argnames='bins'``.
+
+    Returns ``(counts[bins] int32, ess, rows, mass)``."""
+    p = state.priority
+    valid = p > 0
+    rows = jnp.sum(valid.astype(jnp.int32))
+    s1 = jnp.sum(jnp.where(valid, p, 0.0))
+    s2 = jnp.sum(jnp.where(valid, p * p, 0.0))
+    ess = jnp.where(s2 > 0, s1 * s1 / jnp.maximum(s2, 1e-30), 0.0)
+    logp = jnp.log10(jnp.maximum(p, 10.0 ** PRIORITY_XRAY_LOG10_LO))
+    t = (logp - PRIORITY_XRAY_LOG10_LO) / (
+        PRIORITY_XRAY_LOG10_HI - PRIORITY_XRAY_LOG10_LO)
+    b = jnp.clip((t * bins).astype(jnp.int32), 0, bins - 1)
+    counts = jnp.zeros((bins,), jnp.int32).at[
+        jnp.where(valid, b, bins)].add(1, mode="drop")
+    return counts, ess, rows, s1
 
 
 def per_update_priorities(state: PerReplayState, idx: jax.Array,
@@ -157,6 +190,7 @@ class DevicePerReplay(DeviceReplay):
         base = super()._init_state()
         return PerReplayState(
             *base[:6],
+            prov=base.prov,
             priority=self._alloc((self.capacity,), jnp.float32),
             max_priority=self._alloc((), jnp.float32, sharded=False) + 1.0,
             pos=base.pos,
@@ -216,13 +250,15 @@ class DevicePerReplay(DeviceReplay):
         shift = -pos if fill == self.capacity else 0
         out = {k: np.roll(np.asarray(getattr(st, k)), shift,
                           axis=0)[:fill].copy()
-               for k in Transition._fields}
+               for k in REPLAY_FIELDS}
         if self.channels_last:  # public schema is NCHW (see DeviceReplay)
             from pytorch_distributed_tpu.memory.device_replay import (
                 snapshot_states_to_nchw,
             )
 
             out = snapshot_states_to_nchw(out)
+        out["prov"] = np.roll(np.asarray(st.prov), shift,
+                              axis=0)[:fill].astype(np.int64)
         out["leaf_priority"] = np.roll(
             np.asarray(st.priority), shift)[:fill].copy()
         # stored p^alpha on device; snapshot in the shared UNexponentiated

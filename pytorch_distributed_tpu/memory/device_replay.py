@@ -25,7 +25,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_distributed_tpu.utils.experience import Batch, Transition
+from pytorch_distributed_tpu.utils import experience
+from pytorch_distributed_tpu.utils.experience import (
+    REPLAY_FIELDS, Batch, Transition,
+)
 
 
 class ReplayState(NamedTuple):
@@ -35,6 +38,11 @@ class ReplayState(NamedTuple):
     gamma_n: jax.Array
     state1: jax.Array
     terminal1: jax.Array
+    # data-plane provenance columns (ISSUE 8): (actor_id, env_slot,
+    # param_version, birth_step) per row as int32 (-1 = unknown) — kept
+    # AFTER the six replay columns so ``state[:6]`` keeps meaning the
+    # replay schema for the PER subclass's constructor
+    prov: jax.Array       # (N, 4) int32
     pos: jax.Array        # int32 write cursor
     fill: jax.Array       # int32 number of valid rows
 
@@ -46,7 +54,7 @@ def ring_write(state, chunk: Transition, capacity: int):
     per-row fields at the same slots."""
     n = chunk.reward.shape[0]
     idx = (state.pos + jnp.arange(n, dtype=jnp.int32)) % capacity
-    return state._replace(
+    repl = dict(
         state0=state.state0.at[idx].set(chunk.state0),
         action=state.action.at[idx].set(chunk.action),
         reward=state.reward.at[idx].set(chunk.reward),
@@ -55,7 +63,15 @@ def ring_write(state, chunk: Transition, capacity: int):
         terminal1=state.terminal1.at[idx].set(chunk.terminal1),
         pos=(state.pos + n) % capacity,
         fill=jnp.minimum(state.fill + n, capacity),
-    ), idx
+    )
+    prov_col = getattr(state, "prov", None)
+    if prov_col is not None:
+        # rows without provenance overwrite with the -1 sentinel (a
+        # recycled slot must never keep its previous row's provenance)
+        repl["prov"] = prov_col.at[idx].set(
+            jnp.full((n, prov_col.shape[1]), -1, prov_col.dtype)
+            if chunk.prov is None else chunk.prov.astype(prov_col.dtype))
+    return state._replace(**repl), idx
 
 
 def _feed(state: ReplayState, chunk: Transition, capacity: int) -> ReplayState:
@@ -79,7 +95,7 @@ def ring_write_masked(state, chunk: Transition, valid,
     idx = jnp.where(valid, (state.pos + offs) % capacity, capacity)
     total = jnp.sum(valid.astype(jnp.int32))
     wr = lambda buf, x: buf.at[idx].set(x, mode="drop")
-    return state._replace(
+    repl = dict(
         state0=wr(state.state0, chunk.state0),
         action=wr(state.action, chunk.action),
         reward=wr(state.reward, chunk.reward),
@@ -88,7 +104,14 @@ def ring_write_masked(state, chunk: Transition, valid,
         terminal1=wr(state.terminal1, chunk.terminal1),
         pos=(state.pos + total) % capacity,
         fill=jnp.minimum(state.fill + total, capacity),
-    ), total
+    )
+    prov_col = getattr(state, "prov", None)
+    if prov_col is not None:
+        n = chunk.reward.shape[0]
+        repl["prov"] = wr(prov_col, (
+            jnp.full((n, prov_col.shape[1]), -1, prov_col.dtype)
+            if chunk.prov is None else chunk.prov.astype(prov_col.dtype)))
+    return state._replace(**repl), total
 
 
 def chunk_to_nhwc(chunk: Transition) -> Transition:
@@ -152,6 +175,17 @@ def sample_rows(state: ReplayState, key: jax.Array,
         weight=jnp.ones((batch_size,), dtype=jnp.float32),
         index=idx.astype(jnp.int32),
     )
+
+
+def provenance_sample(state: ReplayState, key: jax.Array,
+                      n: int):
+    """Gather ``n`` uniformly-drawn rows' provenance columns — the
+    learner's ONE small D2H per stats cadence on the device replay
+    paths (n * 4 int32s; the telemetry is a distribution read, so a
+    bounded sample is the whole point).  Returns ``(prov[n, 4],
+    fill)``; jit with ``static_argnames='n'``."""
+    idx = jax.random.randint(key, (n,), 0, jnp.maximum(state.fill, 1))
+    return state.prov[idx], state.fill
 
 
 def build_uniform_fused_step(step_fn, batch_size: int,
@@ -251,6 +285,9 @@ class DeviceReplay:
             gamma_n=alloc((N,), jnp.float32),
             state1=alloc((N, *self._store_shape), self.state_dtype),
             terminal1=alloc((N,), jnp.float32),
+            # -1 = unknown provenance (the zeros alloc carries the row
+            # sharding; the elementwise subtract preserves it)
+            prov=alloc((N, 4), jnp.int32) - 1,
             pos=alloc((), jnp.int32, sharded=False),
             fill=alloc((), jnp.int32, sharded=False),
         )
@@ -271,7 +308,9 @@ class DeviceReplay:
         shift = -pos if fill == self.capacity else 0
         out = {k: np.roll(np.asarray(getattr(st, k)), shift,
                           axis=0)[:fill].copy()
-               for k in Transition._fields}
+               for k in REPLAY_FIELDS}
+        out["prov"] = np.roll(np.asarray(st.prov), shift,
+                              axis=0)[:fill].astype(np.int64)
         if self.channels_last:
             out = snapshot_states_to_nchw(out)
         return out
@@ -290,7 +329,9 @@ class DeviceReplay:
         n = min(len(rows), self.capacity)
         if n:
             self.feed_chunk(Transition(
-                *(np.asarray(data[k])[-n:] for k in Transition._fields)))
+                *(np.asarray(data[k])[-n:] for k in REPLAY_FIELDS),
+                prov=(np.asarray(data["prov"], np.int32)[-n:]
+                      if "prov" in data else None)))
         return n
 
     def feed_chunk(self, chunk: Transition) -> None:
@@ -388,7 +429,8 @@ class DeviceReplayIngest:
             rows, self._pending = self._pending, []
             self.replay.feed_chunk(Transition(*(
                 np.stack([getattr(r, f) for r in rows]).astype(dt[f])
-                for f in Transition._fields)))
+                for f in REPLAY_FIELDS),
+                prov=experience.stack_prov(rows).astype(np.int32)))
             self._fed_total += len(rows)
         return self.replay.snapshot()
 
@@ -447,7 +489,8 @@ class DeviceReplayIngest:
             rows, self._pending = self._pending[:C], self._pending[C:]
             chunk = Transition(*(
                 np.stack([getattr(r, f) for r in rows]).astype(dt[f])
-                for f in Transition._fields))
+                for f in REPLAY_FIELDS),
+                prov=experience.stack_prov(rows).astype(np.int32))
             self.replay.feed_chunk(chunk)
             fed += C
         self._fed_total += fed

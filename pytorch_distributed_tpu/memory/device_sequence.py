@@ -416,7 +416,7 @@ class DeviceSequenceIngest:
         return SegmentChunk(*(
             np.stack([getattr(r, f) for r in rows]).astype(
                 dt.get(f, np.float32))
-            for f in Segment._fields))
+            for f in SegmentChunk._fields))
 
     # -- checkpoint: drain then delegate to the HBM ring -------------------
 

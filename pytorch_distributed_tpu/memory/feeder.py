@@ -243,6 +243,18 @@ class QueueOwner:
                           priorities: np.ndarray) -> None:
         self.memory.update_priorities(indices, priorities)
 
+    def provenance_of(self, indices: np.ndarray):
+        """Delegated provenance gather (ISSUE 8 data-plane telemetry);
+        None when the wrapped memory keeps no sidecar."""
+        fn = getattr(self.memory, "provenance_of", None)
+        return None if fn is None else fn(indices)
+
+    def priority_leaves(self):
+        """Delegated PER leaf read for the priority X-ray; None for
+        uniform memories."""
+        fn = getattr(self.memory, "priority_leaves", None)
+        return None if fn is None else fn()
+
     def feed(self, transition: Transition,
              priority: Optional[float] = None) -> None:
         self.memory.feed(transition, priority)

@@ -22,7 +22,9 @@ from typing import Optional, Tuple
 import numpy as np
 
 from pytorch_distributed_tpu.memory.base import Memory
-from pytorch_distributed_tpu.utils.experience import Batch, Transition
+from pytorch_distributed_tpu.utils.experience import (
+    REPLAY_FIELDS, Batch, Transition,
+)
 
 _CTX = mp.get_context("spawn")
 
@@ -109,14 +111,14 @@ class NativeRingReplay(Memory):
     def feed(self, transition: Transition,
              priority: Optional[float] = None) -> None:
         row = np.empty(1, dtype=self.row_dtype)
-        for f in Transition._fields:
+        for f in REPLAY_FIELDS:
             row[0][f] = getattr(transition, f)
         get_lib().rb_feed(self._base(), row.ctypes.data, 1)
 
     def feed_batch(self, ts: Transition) -> None:
         n = len(np.atleast_1d(ts.reward))
         rows = np.empty(n, dtype=self.row_dtype)
-        for f in Transition._fields:
+        for f in REPLAY_FIELDS:
             rows[f] = getattr(ts, f)
         get_lib().rb_feed(self._base(), rows.ctypes.data, n)
 

@@ -24,7 +24,9 @@ from typing import Optional, Tuple
 import numpy as np
 
 from pytorch_distributed_tpu.memory.base import Memory
-from pytorch_distributed_tpu.utils.experience import Batch, Transition
+from pytorch_distributed_tpu.utils.experience import (
+    REPLAY_FIELDS, Batch, Transition,
+)
 from pytorch_distributed_tpu.utils.segment_tree import MinTree, SumTree
 
 
@@ -45,6 +47,11 @@ class PrioritizedReplay(Memory):
         self.gamma_n = np.zeros((N,), dtype=np.float32)
         self.state1 = np.zeros((N, *self.state_shape), dtype=self.state_dtype)
         self.terminal1 = np.zeros((N,), dtype=np.float32)
+        # provenance sidecar (ISSUE 8): (actor_id, env_slot,
+        # param_version, birth_step) per row, -1 = unknown.  A sidecar,
+        # NOT a seventh schema column: the six-array replay schema is a
+        # wire/checkpoint contract shared with rings that predate it.
+        self.prov = np.full((N, 4), -1, dtype=np.int64)
         self.sum_tree = SumTree(N)
         self.min_tree = MinTree(N)
         self.alpha = priority_exponent
@@ -80,6 +87,8 @@ class PrioritizedReplay(Memory):
         self.gamma_n[i] = transition.gamma_n
         self.state1[i] = transition.state1
         self.terminal1[i] = transition.terminal1
+        self.prov[i] = (-1 if getattr(transition, "prov", None) is None
+                        else transition.prov)
         pr = self._priority(priority)
         self.sum_tree.set(i, pr)
         self.min_tree.set(i, pr)
@@ -126,7 +135,8 @@ class PrioritizedReplay(Memory):
         n = self.size
         shift = -self._pos if self._full else 0
         out = {k: np.roll(getattr(self, k), shift, axis=0)[:n].copy()
-               for k in Transition._fields}
+               for k in REPLAY_FIELDS}
+        out["prov"] = np.roll(self.prov, shift, axis=0)[:n].copy()
         out["leaf_priority"] = np.roll(
             self.sum_tree.get(np.arange(self.capacity)), shift)[:n].copy()
         # UNexponentiated, the unit every restore path expects — the device
@@ -141,8 +151,11 @@ class PrioritizedReplay(Memory):
     def restore(self, data: dict) -> None:
         rows = np.asarray(data["reward"])
         n = min(len(rows), self.capacity)
-        for k in Transition._fields:
+        for k in REPLAY_FIELDS:
             getattr(self, k)[:n] = data[k][-n:]
+        self.prov[:n] = (np.asarray(data["prov"], np.int64)[-n:]
+                         if "prov" in data else -1)
+        self.prov[n:] = -1
         if "leaf_priority" in data:
             leaves = np.asarray(data["leaf_priority"],
                                 dtype=np.float64)[-n:]
@@ -170,6 +183,16 @@ class PrioritizedReplay(Memory):
         self._full = n == self.capacity
         self.max_priority = float(data.get("max_priority_base", 1.0))
         self._samples_drawn = int(data.get("samples_drawn", 0))
+
+    def provenance_of(self, indices: np.ndarray) -> np.ndarray:
+        """(B, 4) int64 provenance of the given rows; -1 rows = unknown
+        (the learner's data-plane telemetry masks on ``[:, 0] >= 0``)."""
+        return self.prov[np.asarray(indices)]
+
+    def priority_leaves(self) -> np.ndarray:
+        """The valid rows' tree leaves (p^alpha) — the priority X-ray's
+        input (utils/health.priority_xray)."""
+        return self.sum_tree.get(np.arange(self.size))
 
     def update_priorities(self, indices: np.ndarray,
                           priorities: np.ndarray) -> None:

@@ -29,7 +29,11 @@ import numpy as np
 
 
 class Segment(NamedTuple):
-    """One replay row (unbatched)."""
+    """One replay row (unbatched).  ``prov`` is the OPTIONAL data-plane
+    provenance vector of the segment's FIRST step (ISSUE 8 —
+    utils/experience.make_prov); storage keeps it in a sidecar array,
+    never in the segment schema proper (iterate the replay's ``_FIELDS``
+    when you mean the stored columns)."""
 
     obs: np.ndarray        # (T+1, *state_shape)
     action: np.ndarray     # (T,) int32
@@ -38,6 +42,7 @@ class Segment(NamedTuple):
     mask: np.ndarray       # (T,) float32, 1 = valid step
     c0: np.ndarray         # (lstm_dim,) float32
     h0: np.ndarray         # (lstm_dim,) float32
+    prov: Optional[np.ndarray] = None  # (4,) int64 provenance, or None
 
 
 class SegmentBatch(NamedTuple):
@@ -86,18 +91,23 @@ class SegmentBuilder:
 
     def push(self, obs, action, reward, terminal, next_obs,
              carry: Tuple[np.ndarray, np.ndarray],
-             episode_end: Optional[bool] = None) -> List[Segment]:
+             episode_end: Optional[bool] = None,
+             prov=None) -> List[Segment]:
         """``terminal`` is what the learner bootstraps on (False for
         time-limit truncations, which must bootstrap through);
         ``episode_end`` (default: terminal) is what ends the stream — a
-        truncated episode ends the segment without marking a death."""
+        truncated episode ends the segment without marking a death.
+        ``prov`` is this step's provenance vector (minted at action
+        time); an emitted segment carries its FIRST step's provenance,
+        overlap included — the retained steps keep the vectors they were
+        pushed with."""
         if episode_end is None:
             episode_end = bool(terminal)
         c, h = carry
         self._steps.append((
             np.asarray(obs), int(action), float(reward), bool(terminal),
             np.asarray(next_obs), np.asarray(c, np.float32).copy(),
-            np.asarray(h, np.float32).copy()))
+            np.asarray(h, np.float32).copy(), prov))
         out: List[Segment] = []
         if episode_end:
             out.append(self._emit(len(self._steps)))
@@ -117,7 +127,7 @@ class SegmentBuilder:
         reward = np.zeros(T, np.float32)
         terminal = np.zeros(T, np.float32)
         mask = np.zeros(T, np.float32)
-        for t, (o, a, r, term, nxt, _c, _h) in enumerate(steps):
+        for t, (o, a, r, term, nxt, _c, _h, _p) in enumerate(steps):
             action[t] = a
             reward[t] = r
             terminal[t] = float(term)
@@ -134,7 +144,7 @@ class SegmentBuilder:
                 obs[t] = obs[n]
         return Segment(obs=obs, action=action, reward=reward,
                        terminal=terminal, mask=mask,
-                       c0=steps[0][5], h0=steps[0][6])
+                       c0=steps[0][5], h0=steps[0][6], prov=steps[0][7])
 
     def _emit_packed(self, steps, n: int) -> np.ndarray:
         """De-duplicated frame sequence (T+C, H, W): frames [0, C) are
@@ -213,6 +223,9 @@ class SequenceReplay:
         self.mask = np.zeros((capacity, seq_len), np.float32)
         self.c0 = np.zeros((capacity, lstm_dim), np.float32)
         self.h0 = np.zeros((capacity, lstm_dim), np.float32)
+        # provenance sidecar (ISSUE 8): first-step provenance per
+        # segment, -1 rows = unknown (legacy/synthetic feeds)
+        self.prov = np.full((capacity, 4), -1, np.int64)
         self.priority = np.zeros(capacity, np.float64)  # p^alpha, 0 = empty
         self.max_priority = 1.0
         self.pos = 0
@@ -233,6 +246,8 @@ class SequenceReplay:
         self.mask[i] = segment.mask
         self.c0[i] = segment.c0
         self.h0[i] = segment.h0
+        self.prov[i] = (-1 if getattr(segment, "prov", None) is None
+                        else segment.prov)
         if priority is None:
             self.priority[i] = self.max_priority
         else:
@@ -274,6 +289,16 @@ class SequenceReplay:
             mask=self.mask[idx], c0=self.c0[idx], h0=self.h0[idx],
             weight=weights, index=idx.astype(np.int32))
 
+    def priority_leaves(self) -> np.ndarray:
+        """The valid rows' priorities (p^alpha) — the priority X-ray's
+        input (utils/health.priority_xray)."""
+        return self.priority[:self.size]
+
+    def provenance_of(self, indices: np.ndarray) -> np.ndarray:
+        """(B, 4) int64 provenance of the given rows; -1 rows = unknown
+        (the learner's data-plane telemetry masks on ``[:, 0] >= 0``)."""
+        return self.prov[np.asarray(indices)]
+
     def update_priorities(self, indices: np.ndarray,
                           priorities: np.ndarray) -> None:
         """Per-sequence |TD| write-back (eta-blended by the learner)."""
@@ -296,6 +321,7 @@ class SequenceReplay:
         shift = -self.pos if self.full else 0
         out = {k: np.roll(getattr(self, k), shift, axis=0)[:n].copy()
                for k in self._FIELDS}
+        out["prov"] = np.roll(self.prov, shift, axis=0)[:n].copy()
         out["leaf_priority"] = np.roll(self.priority, shift)[:n].copy()
         out["max_priority_base"] = np.float64(
             self.max_priority ** (1.0 / self.alpha) if self.alpha
@@ -314,6 +340,9 @@ class SequenceReplay:
         n = min(len(rows), self.capacity)
         for k in self._FIELDS:
             getattr(self, k)[:n] = data[k][-n:]
+        self.prov[:n] = (np.asarray(data["prov"], np.int64)[-n:]
+                         if "prov" in data else -1)
+        self.prov[n:] = -1
         if "leaf_priority" in data:
             leaves = np.asarray(data["leaf_priority"], np.float64)[-n:]
             saved_alpha = float(data.get("alpha", self.alpha))

@@ -30,6 +30,7 @@ _CTYPES = {
     np.dtype(np.uint8): ctypes.c_uint8,
     np.dtype(np.float32): ctypes.c_float,
     np.dtype(np.int32): ctypes.c_int32,
+    np.dtype(np.int64): ctypes.c_int64,
 }
 
 # all shared primitives come from the spawn context — the start method the
@@ -62,12 +63,19 @@ class SharedReplay(Memory):
             gamma_n=_shared_array((N,), np.float32),
             state1=_shared_array((N, *self.state_shape), self.state_dtype),
             terminal1=_shared_array((N,), np.float32),
+            # provenance sidecar (ISSUE 8), -1 rows = unknown
+            prov=_shared_array((N, 4), np.int64),
         )
         self._pos = _CTX.Value("l", 0, lock=False)     # reference :16
         self._full = _CTX.Value("b", 0, lock=False)    # reference :17
         self._count = _CTX.Value("l", 0, lock=False)   # total feeds (stats)
         self._lock = _CTX.Lock()                       # reference :37
         self._bind_views()
+        # unwritten provenance must read as the explicit -1 sentinel
+        # (mp.Array pages come zeroed, and (0, 0, 0, 0) is a VALID
+        # vector); __init__ only — spawned children share these pages
+        # and must never re-wipe them
+        self._np_prov[:] = -1
 
     # -- pickling across spawn ---------------------------------------------
 
@@ -87,12 +95,13 @@ class SharedReplay(Memory):
         shapes = dict(
             state0=(N, *self.state_shape), action=(N, *self.action_shape),
             reward=(N,), gamma_n=(N,), state1=(N, *self.state_shape),
-            terminal1=(N,),
+            terminal1=(N,), prov=(N, 4),
         )
         dtypes = dict(
             state0=self.state_dtype, action=self.action_dtype,
             reward=np.float32, gamma_n=np.float32,
             state1=self.state_dtype, terminal1=np.float32,
+            prov=np.int64,
         )
         for k, raw in self._raw.items():
             setattr(self, f"_np_{k}", _view(raw, shapes[k], dtypes[k]))
@@ -120,6 +129,8 @@ class SharedReplay(Memory):
             self._np_gamma_n[i] = transition.gamma_n
             self._np_state1[i] = transition.state1
             self._np_terminal1[i] = transition.terminal1
+            self._np_prov[i] = (-1 if getattr(transition, "prov", None)
+                                is None else transition.prov)
             nxt = i + 1
             if nxt >= self.capacity:
                 self._full.value = 1
@@ -151,7 +162,13 @@ class SharedReplay(Memory):
             rows = np.asarray(data["reward"])
             n = min(len(rows), self.capacity)
             for k in self._raw:
+                if k == "prov" and k not in data:
+                    self._np_prov[:n] = -1  # pre-provenance snapshot
+                    continue
                 getattr(self, f"_np_{k}")[:n] = data[k][-n:]
+            # rows beyond the restored region are dead until rewritten:
+            # their provenance must read unknown, not a stale vector
+            self._np_prov[n:] = -1
             self._pos.value = n % self.capacity
             self._full.value = int(n == self.capacity)
             self._count.value = int(data.get("count", n))
@@ -174,3 +191,8 @@ class SharedReplay(Memory):
                 weight=np.ones(batch_size, dtype=np.float32),
                 index=idx.astype(np.int32),
             )
+
+    def provenance_of(self, indices: np.ndarray) -> np.ndarray:
+        """(B, 4) int64 provenance of the given rows; -1 rows = unknown
+        (the learner's data-plane telemetry masks on ``[:, 0] >= 0``)."""
+        return self._np_prov[np.asarray(indices)].copy()

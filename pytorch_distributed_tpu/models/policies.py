@@ -437,9 +437,23 @@ def build_fused_rollout(apply_fn: Callable, env, *, nstep: int,
 
         return jax.jit(rollout, donate_argnums=(1,))
 
-    def rollout(params, carry, ring_state, base_key, tick0, eps):
+    def rollout(params, carry, ring_state, base_key, tick0, eps,
+                prov=None):
+        # ``prov`` (optional): a (3,) int32 of (actor_id, param_version,
+        # birth_step) for THIS dispatch — scattered into the ring's
+        # provenance columns alongside each emitted row, with env_slot =
+        # the env's row index (ISSUE 8).  Stamps quantize to the
+        # dispatch exactly like the chunk-mode host stamps; None leaves
+        # the columns at the -1 sentinel (legacy callers).
         ticks = tick0 + jnp.arange(K)
         capacity = ring_state.reward.shape[0]
+        rows_prov = None
+        if prov is not None:
+            rows_prov = jnp.stack([
+                jnp.full((n,), prov[0], jnp.int32),
+                jnp.arange(n, dtype=jnp.int32),
+                jnp.full((n,), prov[1], jnp.int32),
+                jnp.full((n,), prov[2], jnp.int32)], axis=1)
 
         def body(cs, t):
             c, ring, fed = cs
@@ -448,7 +462,8 @@ def build_fused_rollout(apply_fn: Callable, env, *, nstep: int,
                 ring, Transition(
                     state0=e["state0"], action=e["action"],
                     reward=e["reward"], gamma_n=e["gamma_n"],
-                    state1=e["state1"], terminal1=e["terminal1"]),
+                    state1=e["state1"], terminal1=e["terminal1"],
+                    prov=rows_prov),
                 e["valid"], capacity)
             return (c, ring, fed + wrote), (r, te, tr)
 

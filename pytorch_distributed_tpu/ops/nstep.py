@@ -48,10 +48,14 @@ class NStepAssembler:
         self._buf: deque = deque()  # pending (s, a, r, s_last, term) windows
 
     def feed(self, state0, action, reward, state1, terminal: bool,
-             truncated: bool = False) -> List[Transition]:
+             truncated: bool = False, prov=None) -> List[Transition]:
         """``truncated`` marks episode ends that should still bootstrap
-        (time-limit truncation): windows close but terminal stays 0."""
-        self._buf.append([state0, action, 0.0, 0, state1, False])
+        (time-limit truncation): windows close but terminal stays 0.
+        ``prov`` is the transition's provenance vector minted at THIS
+        action (utils/experience.make_prov); it rides the window and is
+        attached to the emitted row — emissions pop FIFO, so provenance
+        stays aligned with the window that opened on its action."""
+        self._buf.append([state0, action, 0.0, 0, state1, False, prov])
         # accumulate this reward into every open window
         for row in self._buf:
             row[2] += (self.gamma ** row[3]) * reward
@@ -80,7 +84,7 @@ class NStepAssembler:
         return out
 
     def _emit(self, row, terminal: bool) -> Transition:
-        state0, action, r_sum, m, state1, _ = row
+        state0, action, r_sum, m, state1, _, prov = row
         return Transition(
             state0=np.asarray(state0),
             action=np.asarray(action),
@@ -88,6 +92,7 @@ class NStepAssembler:
             gamma_n=np.float32(self.gamma ** m),
             state1=np.asarray(state1),
             terminal1=np.float32(1.0 if terminal else 0.0),
+            prov=prov,
         )
 
     def reset(self) -> None:

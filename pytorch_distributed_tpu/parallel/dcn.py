@@ -94,7 +94,8 @@ import numpy as np
 
 from pytorch_distributed_tpu.agents.param_store import ParamStore
 from pytorch_distributed_tpu.memory.feeder import QueueFeeder
-from pytorch_distributed_tpu.utils import flight_recorder, tracing
+from pytorch_distributed_tpu.utils import experience, flight_recorder, \
+    tracing
 from pytorch_distributed_tpu.utils.experience import Transition
 from pytorch_distributed_tpu.utils.faults import FaultInjector
 
@@ -175,6 +176,13 @@ def encode_chunk(items: List[Tuple[Transition, Optional[float]]]) -> bytes:
         dtype=np.float32)
     cols["priority_ok"] = np.array([p is not None for _, p in items],
                                    dtype=np.bool_)
+    prov = experience.stack_prov(items)
+    if (prov >= 0).any():
+        # provenance rides as one (n, 4) int64 column (ISSUE 8); rows
+        # minted without provenance are the explicit -1 sentinel.  Only
+        # shipped when at least one row carries it, so legacy peers and
+        # synthetic chunks keep their exact wire bytes.
+        cols["prov"] = prov
     if isinstance(items, tracing.TracedChunk):
         cols["trace_id"] = np.array([items.trace_id], dtype=np.uint64)
         cols["trace_born"] = np.array([items.born], dtype=np.float64)
@@ -222,9 +230,19 @@ def decode_chunk(payload: bytes
     ok = cols.get("priority_ok")
     if ok is not None and (ok.ndim != 1 or len(ok) != n):
         raise ValueError("malformed chunk: priority_ok length mismatch")
+    pv = cols.get("prov")
+    if pv is not None and (pv.ndim != 2 or len(pv) != n
+                           or pv.shape[1] != len(experience.PROV_FIELDS)
+                           or pv.dtype.kind not in "iu"):
+        raise ValueError("malformed chunk: prov column must be "
+                         f"(n, {len(experience.PROV_FIELDS)}) integer "
+                         f"(got shape {pv.shape}, dtype {pv.dtype})")
     items: List[Tuple[Transition, Optional[float]]] = []
     for i in range(n):
         t = Transition(*(cols[f][i] for f in _FIELDS))
+        if pv is not None and pv[i][0] >= 0:
+            t = t._replace(prov=np.asarray(pv[i],
+                                           experience.PROV_DTYPE))
         p = pr[i]
         if ok is not None:
             valid = bool(ok[i])
@@ -338,6 +356,11 @@ class DcnGateway:
         return json.dumps({
             "learner_step": int(self.clock.learner_step.value),
             "stop": bool(self.clock.stop.is_set()),
+            # gateway wall clock: remote clients estimate their offset
+            # to the learner host off the reply midpoint (NTP-style),
+            # so tools/timeline.py can align cross-host events on one
+            # clock.  Old peers ignore the extra key.
+            "wall": time.time(),
         }).encode()
 
     @property
@@ -839,6 +862,14 @@ class DcnClient:
         # process's fresh counter still lands above its predecessor's
         self._tick_seq = time.time_ns() // 1_000_000
         self.reconnects = 0
+        # estimated wall-clock offset to the gateway host (seconds to ADD
+        # to local time.time() to land on the gateway's clock), derived
+        # NTP-style from T_CLOCK replies' ``wall`` against the RPC
+        # midpoint and EWMA-smoothed; recorded as ``clock_sync`` flight-
+        # recorder events so tools/timeline.py can align this host's
+        # blackbox/metrics rows onto the learner-host clock
+        self.clock_offset: Optional[float] = None
+        self._offset_logged: Optional[float] = None
         self._closed = False
         self._faults = (faults if faults is not None
                         else FaultInjector.from_env("client"))
@@ -893,10 +924,25 @@ class DcnClient:
                            "process_ind": self.process_ind,
                            "incarnation": self.incarnation}).encode()
 
-    def _handle_reply(self, rtype: int, rpayload: bytes) -> None:
+    def _handle_reply(self, rtype: int, rpayload: bytes,
+                      rpc_mid: Optional[float] = None) -> None:
         if rtype != T_CLOCK:
             return
         msg = json.loads(rpayload.decode())
+        if rpc_mid is not None and "wall" in msg:
+            sample = float(msg["wall"]) - rpc_mid
+            self.clock_offset = (sample if self.clock_offset is None
+                                 else 0.9 * self.clock_offset
+                                 + 0.1 * sample)
+            if (self._offset_logged is None
+                    or abs(self.clock_offset
+                           - self._offset_logged) > 0.05):
+                # logged on first estimate and on >50 ms drift — the
+                # timeline reads the LAST clock_sync of the role's ring
+                self._offset_logged = self.clock_offset
+                self._recorder.record(
+                    "clock_sync", offset=round(self.clock_offset, 6),
+                    slot=self.process_ind)
         self.learner_step = int(msg["learner_step"])
         if msg.get("stop"):
             self.stop.set()
@@ -1000,11 +1046,14 @@ class DcnClient:
             if self.disconnected.is_set() or self._closed:
                 raise self._terminal("session already closed")
             retransmits = 0
+            rpc_mid = None
             while True:
                 try:
                     wire = self._faults.frame(payload)
+                    t_send = time.time()
                     _send_frame(self._sock, ftype, wire)
                     rtype, rpayload = _recv_frame(self._sock)
+                    rpc_mid = (t_send + time.time()) / 2.0
                     break
                 except (ConnectionError, OSError) as e:
                     timed_out = isinstance(e, socket.timeout)
@@ -1025,7 +1074,7 @@ class DcnClient:
                         retransmits += 1
                     # loop retransmits the one unacked frame
             self._last_rpc = time.monotonic()
-            self._handle_reply(rtype, rpayload)
+            self._handle_reply(rtype, rpayload, rpc_mid=rpc_mid)
             return rtype, rpayload
 
     # -- heartbeats ---------------------------------------------------------

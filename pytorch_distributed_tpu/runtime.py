@@ -71,7 +71,7 @@ def _child_main(role: str, agent_type: str, args: tuple) -> None:
     from pytorch_distributed_tpu.utils import flight_recorder
 
     opt = args[0]
-    flight_recorder.configure(opt.log_dir)
+    flight_recorder.configure(opt.log_dir, run_id=opt.refs)
     label = role
     if role in ("actor", "evaluator") and len(args) > 2:
         label = f"{role}-{args[2]}"
@@ -201,7 +201,8 @@ class Topology:
 
         # the run's blackbox home; exported so spawn children inherit it
         # without plumbing (same trick the fault schedules use)
-        flight_recorder.configure(opt.log_dir, export_env=True)
+        flight_recorder.configure(opt.log_dir, export_env=True,
+                                  run_id=opt.refs)
         prev_term = None
         run_over = threading.Event()
         if threading.current_thread() is threading.main_thread():

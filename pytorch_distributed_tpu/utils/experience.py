@@ -40,7 +40,15 @@ def reset_experience() -> Experience:
 
 class Transition(NamedTuple):
     """One n-step replay row — the six-array schema of the reference's
-    shared memory (reference core/memories/shared_memory.py:19-28)."""
+    shared memory (reference core/memories/shared_memory.py:19-28), plus
+    an OPTIONAL provenance sidecar (ISSUE 8): ``prov`` is a ``(4,)``
+    int64 vector ``(actor_id, env_slot, param_version, birth_step)``
+    minted at action time, or None for legacy/synthetic rows.  Replay
+    backends that keep provenance store it in sidecar arrays/columns
+    (never inside the six-array schema), so every pre-existing consumer
+    of the replay fields — wire codecs, checkpoints, jitted feeds —
+    keeps its shape contract; iterate ``REPLAY_FIELDS``, not
+    ``Transition._fields``, when you mean the six replay columns."""
 
     state0: np.ndarray     # (*state_shape,) uint8 or float32
     action: np.ndarray     # () int32 for discrete, (action_dim,) f32 for continuous
@@ -48,6 +56,48 @@ class Transition(NamedTuple):
     gamma_n: np.ndarray    # () float32 — gamma**m effective bootstrap discount
     state1: np.ndarray     # (*state_shape,)
     terminal1: np.ndarray  # () float32 in {0,1}
+    prov: Optional[np.ndarray] = None  # (4,) int64 provenance, or None
+
+
+# the six replay columns proper — what every storage/wire schema means by
+# "the transition fields" (Transition._fields now also carries ``prov``)
+REPLAY_FIELDS = ("state0", "action", "reward", "gamma_n", "state1",
+                 "terminal1")
+
+# provenance vector layout (utils/experience.make_prov): who acted, from
+# which env slot, under which published param version, and the global
+# learner step the actor observed at action time (so sample age is a
+# learner-step subtraction with no clock translation)
+PROV_FIELDS = ("actor_id", "env_slot", "param_version", "birth_step")
+PROV_DTYPE = np.int64
+PROV_NONE = np.full(len(PROV_FIELDS), -1, dtype=PROV_DTYPE)
+
+
+def make_prov(actor_id: int, env_slot: int, param_version: int,
+              birth_step: int) -> np.ndarray:
+    """One provenance vector, minted at action time."""
+    return np.array([actor_id, env_slot, param_version, birth_step],
+                    dtype=PROV_DTYPE)
+
+
+def stack_prov(items) -> np.ndarray:
+    """Stack the provenance of ``[(Transition, priority), ...]`` (or any
+    iterable of objects with a ``prov`` attribute — bare Transitions
+    included) into an ``(n, 4)`` int64 column; rows without provenance
+    become ``(-1, -1, -1, -1)`` (the explicit "unknown" sentinel every
+    consumer masks on)."""
+    rows = []
+    for it in items:
+        # Transition IS a tuple (NamedTuple): only unwrap PLAIN
+        # (item, priority) pairs, or it[0] would be the state array and
+        # every stamped row would silently read as the -1 sentinel
+        t = (it[0] if isinstance(it, tuple)
+             and not hasattr(it, "_fields") else it)
+        p = getattr(t, "prov", None)
+        rows.append(PROV_NONE if p is None
+                    else np.asarray(p, dtype=PROV_DTYPE))
+    return (np.stack(rows) if rows
+            else np.zeros((0, len(PROV_FIELDS)), dtype=PROV_DTYPE))
 
 
 def transition_dtypes(state_dtype, action_dtype) -> dict:

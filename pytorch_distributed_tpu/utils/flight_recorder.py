@@ -40,6 +40,7 @@ import time
 from typing import Dict, List, Optional
 
 _ENV_DIR = "TPU_APEX_BLACKBOX_DIR"
+_ENV_RUN = "TPU_APEX_RUN_ID"
 
 DEFAULT_CAPACITY = 512
 
@@ -83,6 +84,9 @@ class FlightRecorder:
                 f.write(json.dumps({
                     "t": time.time(), "kind": "dump", "role": self.role,
                     "reason": reason, "pid": os.getpid(),
+                    # run attribution (ISSUE 8): timeline correlation
+                    # must not depend on directory layout
+                    "run_id": run_id(),
                     "events": len(events),
                     "recorded_total": self.recorded,
                 }) + "\n")
@@ -115,14 +119,31 @@ def _dump_dir() -> Optional[str]:
     return _configured_dir or os.environ.get(_ENV_DIR) or None
 
 
-def configure(log_dir: str, export_env: bool = False) -> None:
-    """Set this process's dump directory.  ``export_env=True`` also
-    exports it so spawn children inherit (orchestrators only — a child
-    must not clobber what its parent exported)."""
-    global _configured_dir
+_configured_run_id: Optional[str] = None
+
+
+def run_id() -> Optional[str]:
+    """This process's run id (configure(), else the spawn-inherited
+    ``TPU_APEX_RUN_ID``) — stamped into blackbox dump headers and
+    quarantine files so tools/timeline.py correlates artifacts by id,
+    not directory layout."""
+    return _configured_run_id or os.environ.get(_ENV_RUN) or None
+
+
+def configure(log_dir: str, export_env: bool = False,
+              run_id: Optional[str] = None) -> None:
+    """Set this process's dump directory (and optionally the run id).
+    ``export_env=True`` also exports both so spawn children inherit
+    (orchestrators only — a child must not clobber what its parent
+    exported)."""
+    global _configured_dir, _configured_run_id
     _configured_dir = log_dir
+    if run_id:
+        _configured_run_id = str(run_id)
     if export_env:
         os.environ[_ENV_DIR] = log_dir
+        if run_id:
+            os.environ[_ENV_RUN] = str(run_id)
 
 
 def get_recorder(role: str,
@@ -151,7 +172,8 @@ def dump_all(reason: str = "",
 
 def reset() -> None:
     """Drop all recorders and the configured dir (test isolation)."""
-    global _configured_dir
+    global _configured_dir, _configured_run_id
     with _lock:
         _recorders.clear()
     _configured_dir = None
+    _configured_run_id = None

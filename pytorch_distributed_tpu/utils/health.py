@@ -50,6 +50,7 @@ import dataclasses
 import math
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -161,6 +162,70 @@ def suppress_writeback(ok_flag, updated_replay, prior_replay):
 
 
 # ---------------------------------------------------------------------------
+# priority X-ray (ISSUE 8): distribution telemetry over the PER leaves
+# ---------------------------------------------------------------------------
+
+# fixed log10 bucket grid shared with the in-jit device twin
+# (memory/device_per.priority_xray_device) so fleet_top renders either
+PRIORITY_XRAY_LOG10_LO = -6.0
+PRIORITY_XRAY_LOG10_HI = 3.0
+
+
+def provenance_stats(prov, current_version: int,
+                     learner_step: int) -> Optional[Dict[str, Any]]:
+    """The data-plane staleness math, shared by the learner's stats
+    cadence (agents/learner.py) and the overhead bench
+    (bench.bench_provenance_overhead) so the bench measures EXACTLY the
+    production computation.  ``prov`` is an (n, 4) provenance matrix;
+    sentinel rows (actor_id < 0) are masked out.  Returns None when no
+    row carries provenance, else arrays ``staleness`` (versions),
+    ``age`` (learner steps) and ``shares`` (per-actor sample
+    fraction)."""
+    prov = np.asarray(prov)
+    known = prov[prov[:, 0] >= 0]
+    if not len(known):
+        return None
+    _ids, cnt = np.unique(known[:, 0], return_counts=True)
+    return {
+        "staleness": np.maximum(current_version - known[:, 2], 0),
+        "age": np.maximum(learner_step - known[:, 3], 0),
+        "shares": cnt / float(len(known)),
+    }
+
+
+def priority_xray(leaves, bins: int = 16) -> Optional[Dict[str, Any]]:
+    """Summarize a PER leaf vector (p^alpha units) into the data-plane
+    X-ray: a log10-bucketed histogram over the fixed [1e-6, 1e3) decade
+    grid, the effective sample size ``(sum p)^2 / sum p^2`` (how many
+    rows the sampler EFFECTIVELY draws from — n means uniform, ~1 means
+    one row dominates), and its fraction of the row count.  This is the
+    distribution the AnomalyDetector consumes instead of a bare mass
+    ratio: mass can look healthy while ESS has collapsed onto a handful
+    of rows.  Returns None for an empty/all-zero leaf set."""
+    p = np.asarray(leaves, dtype=np.float64)
+    p = p[p > 0]
+    if p.size == 0:
+        return None
+    s1, s2 = float(p.sum()), float((p * p).sum())
+    ess = (s1 * s1 / s2) if s2 > 0 else 0.0
+    logp = np.log10(np.maximum(p, 10.0 ** PRIORITY_XRAY_LOG10_LO))
+    t = (logp - PRIORITY_XRAY_LOG10_LO) / (
+        PRIORITY_XRAY_LOG10_HI - PRIORITY_XRAY_LOG10_LO)
+    b = np.clip((t * bins).astype(np.int64), 0, bins - 1)
+    counts = np.bincount(b, minlength=bins)[:bins]
+    return {
+        "rows": int(p.size),
+        "mass": s1,
+        "ess": ess,
+        "ess_frac": ess / p.size,
+        "counts": counts,
+        "log10_lo": PRIORITY_XRAY_LOG10_LO,
+        "log10_hi": PRIORITY_XRAY_LOG10_HI,
+        "p_max": float(p.max()),
+    }
+
+
+# ---------------------------------------------------------------------------
 # host-side rolling anomaly detection
 # ---------------------------------------------------------------------------
 
@@ -203,16 +268,21 @@ class AnomalyDetector:
       ``zmax`` (warmup: the first ``warmup`` windows never trip);
     - ``grad_spike``       — grad norm above ``grad_spike`` x its EWMA;
     - ``td_explosion``     — mean |TD| above ``grad_spike`` x its EWMA;
-    - ``priority_collapse``— total PER priority mass fell to ~0 while
-      the buffer holds rows (every sample draws the same handful).
+    - ``priority_collapse``— the PER distribution stopped doing useful
+      work: total mass fell to ~0 while the buffer holds rows, or —
+      with the ISSUE-8 priority X-ray wired in — the normalized
+      effective sample size (``priority_ess`` = ESS / rows) fell under
+      ``ess_floor``: mass can look healthy while sampling has
+      concentrated onto a handful of rows.
     """
 
     WARMUP = 8
 
     def __init__(self, zmax: float = 8.0, grad_spike: float = 100.0,
-                 threshold: int = 3):
+                 threshold: int = 3, ess_floor: float = 0.02):
         self.zmax = zmax
         self.grad_spike = grad_spike
+        self.ess_floor = ess_floor
         self.threshold = max(1, int(threshold))
         self.loss = _Ewma()
         self.grad = _Ewma()
@@ -226,7 +296,8 @@ class AnomalyDetector:
                 td_mean: Optional[float] = None,
                 priority_mass: Optional[float] = None,
                 replay_rows: int = 0,
-                skipped: float = 0.0) -> List[str]:
+                skipped: float = 0.0,
+                priority_ess: Optional[float] = None) -> List[str]:
         self.windows += 1
         out: List[str] = []
         if skipped and skipped > 0:
@@ -252,8 +323,10 @@ class AnomalyDetector:
                 # anomalous readings stay OUT of the baseline: a spike
                 # that shifted its own EWMA would mask the next one
                 ewma.update(val)
-        if (priority_mass is not None and replay_rows > 0
-                and priority_mass <= 1e-12):
+        if replay_rows > 0 and (
+                (priority_mass is not None and priority_mass <= 1e-12)
+                or (priority_ess is not None
+                    and priority_ess < self.ess_floor)):
             out.append("priority_collapse")
         self.streak = self.streak + 1 if out else 0
         self.anomalies_total += len(out)
@@ -426,11 +499,14 @@ class QuarantineStore:
         target = self._dir()
         if not target:
             return None
-        from pytorch_distributed_tpu.utils.experience import Transition
+        from pytorch_distributed_tpu.utils.experience import (
+            REPLAY_FIELDS, stack_prov,
+        )
+        from pytorch_distributed_tpu.utils import flight_recorder
         from pytorch_distributed_tpu.utils.tracing import format_trace_id
 
         cols: Dict[str, np.ndarray] = {}
-        for f in Transition._fields:
+        for f in REPLAY_FIELDS:
             vals = [np.asarray(getattr(t, f)) for t, _p, _r in rejected]
             try:
                 cols[f] = np.stack(vals)
@@ -442,6 +518,15 @@ class QuarantineStore:
             dtype=np.float64)
         cols["reason"] = np.array([r for _t, _p, r in rejected])
         cols["trace_id"] = np.array([format_trace_id(trace_id)])
+        # correlation keys (ISSUE 8 satellite): per-row provenance,
+        # capture wall clock and run id — tools/timeline.py joins
+        # quarantine files to the incident timeline by these, never by
+        # directory layout
+        cols["prov"] = stack_prov([(t, p) for t, p, _r in rejected])
+        cols["wall"] = np.array([time.time()], dtype=np.float64)
+        rid = flight_recorder.run_id()
+        if rid:
+            cols["run_id"] = np.array([rid])
         safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
                        for c in self.source) or "source"
         path = os.path.join(target, f"{safe}-{n:05d}.npz")

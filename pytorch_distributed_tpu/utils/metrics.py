@@ -121,6 +121,26 @@ class MetricsWriter:
                 self._tb.add_scalar(f"{tag}/{k}", float(s[k]), int(step),
                                     walltime=rec["wall"])
 
+    def bucket_histogram(self, tag: str, counts, *, log10_lo: float,
+                         log10_hi: float, step: int,
+                         wall: Optional[float] = None,
+                         extra: Optional[dict] = None) -> None:
+        """One pre-bucketed distribution row (``kind: "buckets"``) —
+        for distributions summarized at the SOURCE (the ISSUE-8
+        priority X-ray buckets its leaves in-jit on device so only the
+        counts cross to the host; raw values never exist host-side).
+        ``counts`` spans the fixed log10 grid [log10_lo, log10_hi);
+        ``extra`` scalars (ess, mass, ...) ride the same row.  JSONL
+        only — TB gets the companion scalar rows the caller writes."""
+        rec = {"tag": tag, "kind": "buckets", "step": int(step),
+               "wall": wall if wall is not None else time.time(),
+               "counts": [int(c) for c in counts],
+               "log10_lo": float(log10_lo), "log10_hi": float(log10_hi)}
+        if extra:
+            rec.update({k: (float(v) if isinstance(v, (int, float))
+                            else v) for k, v in extra.items()})
+        self._write(rec)
+
     def span(self, span: str, role: str, trace_id: str, dur_ms: float,
              step: int = 0, wall: Optional[float] = None) -> None:
         """One sampled distributed-trace event (utils/tracing.py).  JSONL

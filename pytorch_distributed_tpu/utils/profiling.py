@@ -42,6 +42,7 @@ class StepTimer:
         self._acc: Dict[str, float] = {}
         self._max: Dict[str, float] = {}
         self._n: Dict[str, int] = {}
+        self._last_wall: Dict[str, float] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -61,6 +62,12 @@ class StepTimer:
         if seconds > self._max.get(name, 0.0):
             self._max[name] = seconds
         self._n[name] = self._n.get(name, 0) + 1
+        # wall epoch of the phase's LAST occurrence this window: drained
+        # rows otherwise carry only the flush wall, which lets a
+        # timeline mis-order a stalled phase against blackbox events by
+        # a whole cadence (ISSUE 8 satellite; tools/timeline.py reads
+        # the *_last_wall row as the phase's true clock position)
+        self._last_wall[name] = time.time()
 
     def drain(self) -> Dict[str, float]:
         out = {}
@@ -75,9 +82,14 @@ class StepTimer:
             # is what a stacked phase-share plot needs
             # (tools/plot_run.py --phase-breakdown)
             out[f"{self.prefix}/time_{name}_total_ms"] = secs * 1e3
+            # schema-additive (plot_run's _total_ms regex ignores it):
+            # the epoch above, exported as a plain scalar row
+            out[f"{self.prefix}/time_{name}_last_wall"] = \
+                self._last_wall.get(name, 0.0)
         self._acc.clear()
         self._max.clear()
         self._n.clear()
+        self._last_wall.clear()
         return out
 
 

@@ -30,7 +30,7 @@ def _seg(v: float) -> Segment:
 def _chunk(vals) -> SegmentChunk:
     segs = [_seg(float(v)) for v in vals]
     return SegmentChunk(*(np.stack([getattr(s, f) for s in segs])
-                          for f in Segment._fields))
+                          for f in SegmentChunk._fields))
 
 
 def _mk(capacity=8, alpha=1.0):

@@ -1,0 +1,240 @@
+"""Unified incident timeline (ISSUE 8): artifact merge + clock
+alignment + --around filtering + Perfetto export schema + the CLI
+smoke, driven by a REAL seeded poison drill through the production
+components (fault injector -> feeder -> quarantine -> blackbox dump)
+rather than synthetic fixtures."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.memory.feeder import QueueOwner
+from pytorch_distributed_tpu.memory.prioritized import PrioritizedReplay
+from pytorch_distributed_tpu.utils import flight_recorder, health, tracing
+from pytorch_distributed_tpu.utils.experience import Transition, make_prov
+from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import timeline  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("FEEDER_FAULTS", "ACTOR_FAULTS", "LEARNER_FAULTS"):
+        monkeypatch.delenv(var, raising=False)
+    flight_recorder.reset()
+    health.reset()
+    tracing.reset()
+    yield
+    flight_recorder.reset()
+    health.reset()
+    tracing.reset()
+
+
+def _mk_transition(v: float, prov=None) -> Transition:
+    return Transition(
+        state0=np.full((4,), v, np.float32), action=np.int32(0),
+        reward=np.float32(v), gamma_n=np.float32(0.99),
+        state1=np.full((4,), v + 1, np.float32),
+        terminal1=np.float32(0.0), prov=prov)
+
+
+@pytest.fixture()
+def drill_dir(tmp_path, monkeypatch):
+    """A seeded poison drill through the REAL components: the feeder
+    fault plane poisons chunk #1, the QueueOwner ingest boundary
+    quarantines it, the sentinel records the anomaly, and the run's
+    rings dump — exactly the artifact set a production incident leaves,
+    plus metrics rows and a remote role's clock_sync events."""
+    log_dir = str(tmp_path)
+    monkeypatch.setenv("FEEDER_FAULTS", "poison_chunk@0")
+    flight_recorder.configure(log_dir, run_id="drillrun")
+    owner = QueueOwner(PrioritizedReplay(capacity=32, state_shape=(4,),
+                                         state_dtype=np.float32))
+    feeder = owner.make_feeder(chunk=4)
+    for i in range(4):
+        feeder.feed(_mk_transition(i, make_prov(0, i, 1, i)), 0.5)
+    feeder.flush()  # fault plane poisons THIS chunk (frame 0)
+    # the spawn-context queue delivers through a feeder thread: drain
+    # until the poisoned chunk lands in quarantine (bounded)
+    deadline = time.time() + 10.0
+    while (health.get_quarantine("feeder-local").count < 4
+           and time.time() < deadline):
+        owner.drain()
+        time.sleep(0.02)
+    assert health.get_quarantine("feeder-local").count == 4
+    # learner-side incident records + recovery marker
+    rec = flight_recorder.get_recorder("learner")
+    rec.record("anomaly", step=100, kinds=["skipped"], streak=1)
+    rec.record("rollback", epoch=3, step=90, reason="poison drill")
+    rec.record("recovered", step=90)
+    # a remote actor's ring with a clock offset (its host clock runs
+    # 2.5 s BEHIND the learner host's)
+    actor_rec = flight_recorder.get_recorder("actor-0")
+    actor_rec.record("clock_sync", offset=2.5, slot=0)
+    actor_rec.record("episode", reward=1.0)
+    # hand-stamp a dcn-client ring too (the role that records offsets
+    # in production)
+    cli = flight_recorder.get_recorder("dcn-client-0")
+    cli.record("clock_sync", offset=2.5, slot=0)
+    flight_recorder.dump_all("drill complete")
+    # metrics rows: a health scalar, a span, a priority X-ray row
+    w = MetricsWriter(log_dir, enable_tensorboard=False, role="learner",
+                      run_id="drillrun")
+    w.scalar("health/skipped_steps", 1.0, step=100)
+    w.span("learn", role="learner", trace_id="00ab", dur_ms=12.5,
+           step=100)
+    w.bucket_histogram("replay/priority", [5, 3, 0, 1], log10_lo=-6.0,
+                       log10_hi=3.0, step=100,
+                       extra={"ess": 6.4, "ess_frac": 0.71, "mass": 4.5,
+                              "rows": 9})
+    w.close()
+    return log_dir
+
+
+class TestBuildTimeline:
+    def test_merges_all_planes_ordered(self, drill_dir):
+        events = timeline.build_timeline(drill_dir)
+        sources = {e["source"] for e in events}
+        assert {"blackbox", "scalars", "span", "quarantine"} <= sources
+        walls = [e["wall"] for e in events]
+        assert walls == sorted(walls)
+        kinds = {e["kind"] for e in events}
+        # the drill's skeleton: injected fault, quarantine, rollback,
+        # recovery, the priority X-ray and the health scalar
+        assert {"fault", "quarantine", "rollback", "recovered",
+                "priority_xray", "scalar", "span"} <= kinds
+        q = next(e for e in events if e["kind"] == "quarantine")
+        assert q["run_id"] == "drillrun"
+        assert "actor(s) [0]" in q["detail"]
+        dump = next(e for e in events if e["kind"] == "blackbox_dump")
+        assert dump["run_id"] == "drillrun"
+
+    def test_clock_offset_applied_to_remote_roles(self, drill_dir):
+        events = timeline.build_timeline(drill_dir)
+        actor_ev = [e for e in events if e["role"] == "actor-0"]
+        assert actor_ev
+        for e in actor_ev:
+            assert e["clock_offset"] == pytest.approx(2.5)
+            assert e["wall"] == pytest.approx(e["raw_wall"] + 2.5)
+        learner_ev = [e for e in events if e["role"] == "learner"
+                      and e["source"] == "blackbox"]
+        assert all(e["clock_offset"] == 0.0 for e in learner_ev)
+
+    def test_fault_precedes_quarantine_precedes_recovery(self, drill_dir):
+        """The causal chain the tool exists to reconstruct: injected
+        poison -> quarantine divert -> anomaly -> rollback ->
+        recovery, in clock order across roles."""
+        events = timeline.build_timeline(drill_dir)
+        order = [e["kind"] for e in events
+                 if e["kind"] in ("fault", "quarantine", "anomaly",
+                                  "rollback", "recovered")]
+        assert order.index("fault") < order.index("quarantine")
+        assert order.index("anomaly") < order.index("rollback")
+        assert "recovered" in order
+
+    def test_around_window_filters(self, drill_dir):
+        events = timeline.build_timeline(drill_dir)
+        cut = timeline.filter_around(events, "poison", window=60.0)
+        assert cut
+        assert any(e.get("anchor") for e in cut)
+        assert any(e["kind"] == "quarantine" for e in cut)
+        # a zero-width window keeps (near) only the anchor's instant
+        tight = timeline.filter_around(events, "quarantine",
+                                       window=0.0)
+        assert tight and all(
+            abs(e["wall"] - next(x["wall"] for x in tight
+                                 if x.get("anchor"))) <= 0.0
+            for e in tight)
+        assert timeline.filter_around(events, "no-such-event", 10) == []
+
+    def test_render_text_marks_incident_lines(self, drill_dir):
+        events = timeline.build_timeline(drill_dir)
+        text = timeline.render_text(events)
+        assert "quarantine" in text
+        assert "!!" in text  # loud incident marker
+
+
+class TestPerfettoExport:
+    def test_trace_event_schema(self, drill_dir):
+        events = timeline.build_timeline(drill_dir)
+        doc = timeline.to_perfetto(events)
+        assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+        assert doc["displayTimeUnit"] == "ms"
+        json.dumps(doc)  # must be plain-JSON serializable
+        phases = set()
+        for ev in doc["traceEvents"]:
+            assert isinstance(ev["name"], str) and ev["name"]
+            assert ev["ph"] in ("M", "i", "X", "C")
+            assert isinstance(ev["pid"], int)
+            if ev["ph"] != "M":
+                assert ev["ts"] >= 0
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+            if ev["ph"] == "i":
+                assert ev["s"] in ("g", "p", "t")
+            phases.add(ev["ph"])
+        assert {"M", "i", "X", "C"} <= phases  # all mappings exercised
+        # every role got a named process
+        names = {ev["args"]["name"] for ev in doc["traceEvents"]
+                 if ev["ph"] == "M"}
+        assert "learner" in names and "actor-0" in names
+
+    def test_cli_perfetto_writes_valid_json(self, drill_dir, tmp_path):
+        out = str(tmp_path / "trace.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "timeline.py"),
+             drill_dir, "--perfetto", out],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr
+        with open(out) as f:
+            doc = json.load(f)
+        assert doc["traceEvents"]
+
+
+class TestCli:
+    def test_json_smoke(self, drill_dir):
+        """Tier-1 CLI smoke (ISSUE 8 satellite): --json emits a parseable
+        ordered event list; --around narrows it."""
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "timeline.py"),
+             drill_dir, "--json"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert proc.returncode == 0, proc.stderr
+        events = json.loads(proc.stdout)
+        assert len(events) >= 8
+        assert all("wall" in e and "role" in e and "kind" in e
+                   for e in events)
+        proc2 = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "timeline.py"),
+             drill_dir, "--around", "rollback", "--window", "120",
+             "--json"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert proc2.returncode == 0, proc2.stderr
+        cut = json.loads(proc2.stdout)
+        assert any(e["kind"] == "rollback" for e in cut)
+
+    def test_missing_dir_exits_2(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "timeline.py"),
+             "/no/such/dir", "--json"],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 2
+
+    def test_no_match_exits_1(self, drill_dir):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "timeline.py"),
+             drill_dir, "--around", "zzz-no-such"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 1

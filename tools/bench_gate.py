@@ -66,6 +66,8 @@ SPECS: List[Tuple[str, str, str]] = [
     ("e2e_paced_updates_per_sec", "higher", "e2e"),
     ("health_overhead.health_overhead_frac", "lower_abs", "overhead"),
     ("perf_overhead.perf_overhead_frac", "lower_abs", "overhead"),
+    ("provenance_overhead.provenance_overhead_frac", "lower_abs",
+     "overhead"),
     ("device_env.host_frames_per_sec", "higher", "device_env"),
     ("device_env.device_frames_per_sec", "higher", "device_env"),
     ("device_env.fused_frames_per_sec", "higher", "device_env"),

@@ -72,7 +72,8 @@ def _fmt_bytes(n: Optional[float]) -> str:
 # watermark prefix); everything else in the JSONL stays for plot_run/TB
 _METRIC_TAGS = ("learner/mfu", "learner/updates_per_s",
                 "learner/replay_ratio", "learner/ingest_queue_util",
-                "actor/env_frames_per_s")
+                "actor/env_frames_per_s",
+                "replay/priority_ess", "replay/priority_ess_frac")
 
 
 def perf_line(status: dict,
@@ -125,6 +126,52 @@ def perf_line(status: dict,
     return "  perf: " + " · ".join(bits) if bits else None
 
 
+def data_values(status: dict,
+                metrics_latest: Optional[Dict[str, float]] = None
+                ) -> Dict[str, float]:
+    """The ISSUE-8 data-plane readings (``data/*`` gauges from the
+    STATUS perf block merged with the --metrics overlay) — the
+    machine-readable form ``--json`` includes as a ``data`` block."""
+    vals: Dict[str, float] = {}
+    for snap in (status.get("perf") or {}).values():
+        for k, v in snap.items():
+            if isinstance(v, (int, float)):
+                vals.setdefault(k, v)
+    for k, v in (metrics_latest or {}).items():
+        vals[k] = v
+    out = {k: v for k, v in vals.items() if k.startswith("data/")}
+    ess = vals.get("data/priority_ess",
+                   vals.get("replay/priority_ess_frac"))
+    if ess is not None:
+        out.setdefault("data/priority_ess", ess)
+    return out
+
+
+def data_line(status: dict,
+              metrics_latest: Optional[Dict[str, float]] = None
+              ) -> Optional[str]:
+    """One panel line for the ISSUE-8 data plane: how stale is the
+    experience the learner is consuming, and is the priority
+    distribution still doing useful work.  Sourced from the learner
+    monitor's ``data/*`` gauges in the STATUS perf block (present with
+    TPU_APEX_PERF=1) merged with the --metrics overlay."""
+    vals = dict(data_values(status, metrics_latest))
+    bits = []
+    st = vals.get("data/staleness_p50")
+    if st is not None:
+        bits.append(f"staleness p50 {st:g}v")
+    age = vals.get("data/sample_age_p95")
+    if age is not None:
+        bits.append(f"sample age p95 {age:g} steps")
+    ess = vals.get("data/priority_ess")
+    if ess is not None:
+        bits.append(f"priority ESS {ess:.0%}")
+    share = vals.get("data/top_actor_share")
+    if share is not None:
+        bits.append(f"top actor {share:.0%}")
+    return "  data: " + " · ".join(bits) if bits else None
+
+
 def actor_line(status: dict) -> Optional[str]:
     """Per-actor slot line: env frames/s attributed to each LOCAL
     actor slot plus the schedule it actually runs (device / pipelined
@@ -175,6 +222,9 @@ def render(status: dict,
     pline = perf_line(status, metrics_latest)
     if pline:
         lines.append(pline)
+    dline = data_line(status, metrics_latest)
+    if dline:
+        lines.append(dline)
     aline = actor_line(status)
     if aline:
         lines.append(aline)
@@ -223,7 +273,7 @@ def _absorb_rows(latest: Dict[str, float], rows: List[dict]) -> None:
         tag = r.get("tag")
         if not tag or "value" not in r:
             continue
-        if tag in _METRIC_TAGS or tag.startswith("perf/"):
+        if tag in _METRIC_TAGS or tag.startswith(("perf/", "data/")):
             latest[tag] = r["value"]
 
 
@@ -308,6 +358,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             _absorb_rows(latest, tail.poll())
             if args.json and latest:
                 status = dict(status, metrics_latest=latest)
+        if args.json:
+            dvals = data_values(status, latest)
+            if dvals:  # the data-plane block, CI-assertable
+                status = dict(status, data=dvals)
         print(json.dumps(status, indent=2, sort_keys=True) if args.json
               else render(status, latest))
         return 0

@@ -1,0 +1,418 @@
+#!/usr/bin/env python
+"""Unified fleet incident timeline (ISSUE 8 tentpole part 3).
+
+Post-mortem data for one run is scattered across four artifact planes —
+flight-recorder blackbox rings (``blackbox/<role>.jsonl``), the metrics
+stream (``scalars.jsonl``: scalars, histogram rows, sampled trace
+spans), ingest-quarantine files (``quarantine/<source>-<n>.npz``) and
+injected-fault records (which land in the blackbox rings) — with no way
+to read them as ONE story.  This tool merges them into a single
+clock-aligned, causally-ordered timeline:
+
+- **Clock alignment**: every DCN client estimates its wall-clock offset
+  to the learner-host gateway off T_CLOCK reply midpoints (NTP-style,
+  parallel/dcn.py) and records it as ``clock_sync`` blackbox events;
+  the timeline shifts each remote role's events by its latest recorded
+  offset, so cross-host ordering is honest to ~RTT/2 rather than to
+  whatever the hosts' clocks drifted to.  Single-host runs need no
+  shift.
+- **Correlation keys**: rows join on ``run_id`` (stamped by
+  MetricsWriter, blackbox dump headers and quarantine files), trace ids
+  (spans + quarantine), and the ISSUE-8 provenance columns — never on
+  directory layout.
+- **Filtering**: ``--around PATTERN --window N`` cuts the timeline to
+  ±N seconds around the first event matching PATTERN (substring on
+  kind/tag/detail — e.g. ``--around EXIT_HUNG``, ``--around rollback``,
+  ``--around quarantine``).
+- **Export**: ``--json`` for machines; ``--perfetto out.json`` writes
+  Chrome trace-event JSON (instants for blackbox/quarantine events,
+  complete-events for sampled spans, counters for scalar series) that
+  opens directly in Perfetto / chrome://tracing.
+
+Usage:
+    python tools/timeline.py logs/<refs>
+    python tools/timeline.py logs/<refs> --around poison --window 10
+    python tools/timeline.py logs/<refs> --perfetto trace.json
+    python tools/timeline.py logs/<refs> --json | jq '.[0]'
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from pytorch_distributed_tpu.utils.metrics import read_scalars  # noqa: E402
+
+# scalar tags included by default (the data/health planes a post-mortem
+# reads); everything else needs --all-scalars.  Spans, histogram rows
+# and bucket rows are always included — they are sparse by design.
+_DEFAULT_SCALAR_PREFIXES = (
+    "health/", "replay/priority", "learner/staleness",
+    "learner/sample_age", "replay/actor_share", "perf/",
+)
+
+# blackbox event kinds that mark the *incident* skeleton — rendered
+# prominently and matched first by --around
+_LOUD_KINDS = {
+    "fault", "rollback", "anomaly", "dump", "dcn-terminal", "reconnect",
+    "divergence-fatal", "quarantine", "hang-kill", "preemption",
+    "session-start", "prefetch-failed",
+}
+
+
+def _detail(fields: Dict[str, Any], limit: int = 160) -> str:
+    parts = []
+    for k, v in fields.items():
+        if k in ("t", "kind", "wall", "role", "run_id"):
+            continue
+        parts.append(f"{k}={v}")
+    out = " ".join(parts)
+    return out if len(out) <= limit else out[: limit - 1] + "…"
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue  # torn line (SIGKILL mid-write)
+    except OSError:
+        return []
+    return out
+
+
+def collect_blackbox(log_dir: str) -> List[dict]:
+    """Blackbox rings -> events; the dump header itself becomes a
+    ``blackbox_dump`` event (it records WHY the ring was written)."""
+    events: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(log_dir, "blackbox",
+                                              "*.jsonl"))):
+        rows = _read_jsonl(path)
+        if not rows:
+            continue
+        role = os.path.splitext(os.path.basename(path))[0]
+        run_id = None
+        for r in rows:
+            if r.get("kind") == "dump":
+                role = r.get("role", role)
+                run_id = r.get("run_id")
+                events.append({
+                    "wall": float(r.get("t", 0.0)), "role": role,
+                    "kind": "blackbox_dump", "source": "blackbox",
+                    "run_id": run_id,
+                    "detail": _detail({"reason": r.get("reason", ""),
+                                       "events": r.get("events")}),
+                    "data": r,
+                })
+                continue
+            events.append({
+                "wall": float(r.get("t", 0.0)), "role": role,
+                "kind": str(r.get("kind", "event")),
+                "source": "blackbox", "run_id": run_id,
+                "detail": _detail(r), "data": r,
+            })
+    return events
+
+
+def collect_scalars(log_dir: str, all_scalars: bool = False) -> List[dict]:
+    events: List[dict] = []
+    for r in read_scalars(log_dir):
+        tag = r.get("tag")
+        if not tag or "wall" not in r:
+            continue
+        kind = r.get("kind")
+        role = r.get("role", "metrics")
+        run_id = r.get("run_id")
+        if kind == "span":
+            events.append({
+                "wall": float(r["wall"]), "role": role, "kind": "span",
+                "source": "span", "run_id": run_id, "tag": tag,
+                "detail": f"{r.get('span', tag)} "
+                          f"{r.get('value', 0):.3f}ms "
+                          f"trace={r.get('trace_id', '')}",
+                "data": r,
+            })
+        elif kind == "histogram":
+            events.append({
+                "wall": float(r["wall"]), "role": role,
+                "kind": "histogram", "source": "scalars",
+                "run_id": run_id, "tag": tag,
+                "detail": f"{tag} p50={r.get('p50')} p95={r.get('p95')} "
+                          f"max={r.get('max')} n={r.get('count')}",
+                "data": r,
+            })
+        elif kind == "buckets":
+            events.append({
+                "wall": float(r["wall"]), "role": role,
+                "kind": "priority_xray", "source": "scalars",
+                "run_id": run_id, "tag": tag,
+                "detail": f"{tag} rows={r.get('rows')} "
+                          f"ess={r.get('ess')} "
+                          f"ess_frac={r.get('ess_frac')}",
+                "data": r,
+            })
+        elif "value" in r:
+            if not all_scalars and not tag.startswith(
+                    _DEFAULT_SCALAR_PREFIXES):
+                continue
+            events.append({
+                "wall": float(r["wall"]), "role": role, "kind": "scalar",
+                "source": "scalars", "run_id": run_id, "tag": tag,
+                "detail": f"{tag}={r['value']:g} @step {r.get('step')}",
+                "data": r,
+            })
+    return events
+
+
+def collect_quarantine(log_dir: str) -> List[dict]:
+    events: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(log_dir, "quarantine",
+                                              "*.npz"))):
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                cols = {k: z[k] for k in z.files}
+        except Exception:  # noqa: BLE001 - a torn file must not kill the report
+            continue
+        reasons = [str(x) for x in cols.get("reason", [])]
+        n = len(reasons) or len(cols.get("priority", []))
+        wall = (float(cols["wall"][0]) if "wall" in cols
+                else os.path.getmtime(path))
+        run_id = str(cols["run_id"][0]) if "run_id" in cols else None
+        trace = str(cols["trace_id"][0]) if "trace_id" in cols else ""
+        actors: List[int] = []
+        pv = cols.get("prov")
+        if pv is not None and np.ndim(pv) == 2:
+            actors = sorted({int(a) for a in pv[:, 0] if a >= 0})
+        source = os.path.basename(path).rsplit("-", 1)[0]
+        events.append({
+            "wall": wall, "role": source, "kind": "quarantine",
+            "source": "quarantine", "run_id": run_id,
+            "detail": f"{n} transition(s) ({reasons[0] if reasons else '?'})"
+                      + (f" from actor(s) {actors}" if actors else "")
+                      + (f" trace={trace}" if trace else "")
+                      + f" file={os.path.basename(path)}",
+            "data": {"path": path, "reasons": reasons[:8],
+                     "actors": actors, "trace_id": trace},
+        })
+    return events
+
+
+def clock_offsets(events: List[dict]) -> Dict[str, float]:
+    """Per-role wall-clock corrections from the LATEST ``clock_sync``
+    blackbox event each DCN client recorded.  The offset of client slot
+    ``s`` applies to its own ring role (``dcn-client-s``) and to the
+    co-process roles that share its host clock (``actor-s``)."""
+    out: Dict[str, float] = {}
+    best: Dict[int, tuple] = {}
+    for e in events:
+        if e.get("kind") != "clock_sync":
+            continue
+        slot = e.get("data", {}).get("slot")
+        off = e.get("data", {}).get("offset")
+        if slot is None or off is None:
+            continue
+        prev = best.get(int(slot))
+        if prev is None or e["wall"] > prev[0]:
+            best[int(slot)] = (e["wall"], float(off))
+    for slot, (_w, off) in best.items():
+        out[f"dcn-client-{slot}"] = off
+        out[f"actor-{slot}"] = off
+    return out
+
+
+def build_timeline(log_dir: str, all_scalars: bool = False) -> List[dict]:
+    events = (collect_blackbox(log_dir)
+              + collect_scalars(log_dir, all_scalars)
+              + collect_quarantine(log_dir))
+    offsets = clock_offsets(events)
+    for e in events:
+        off = offsets.get(e.get("role", ""), 0.0)
+        e["raw_wall"] = e["wall"]
+        e["clock_offset"] = off
+        e["wall"] = e["wall"] + off
+    events.sort(key=lambda e: (e["wall"], e.get("role", "")))
+    return events
+
+
+def filter_around(events: List[dict], pattern: str,
+                  window: float) -> List[dict]:
+    """Events within ±window seconds of the first match of ``pattern``
+    (case-insensitive substring over kind, tag and detail; loud incident
+    kinds are searched first so ``--around fault`` anchors on the fault,
+    not on a scalar row that mentions it)."""
+    pat = pattern.lower()
+
+    def matches(e: dict) -> bool:
+        return (pat in e.get("kind", "").lower()
+                or pat in str(e.get("tag", "")).lower()
+                or pat in e.get("detail", "").lower())
+
+    anchor = next((e for e in events
+                   if e.get("kind", "").lower() in _LOUD_KINDS
+                   and matches(e)), None)
+    if anchor is None:
+        anchor = next((e for e in events if matches(e)), None)
+    if anchor is None:
+        return []
+    t0 = anchor["wall"]
+    out = [e for e in events if abs(e["wall"] - t0) <= window]
+    for e in out:
+        e["anchor"] = e is anchor
+    return out
+
+
+def render_text(events: List[dict], limit: int = 200) -> str:
+    if not events:
+        return "(no events)"
+    t0 = events[0]["wall"]
+    lines = [f"timeline: {len(events)} event(s) from "
+             f"{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(t0))} "
+             f"(t0)"]
+    shown = events if len(events) <= limit else events[:limit]
+    for e in shown:
+        mark = ">>" if e.get("anchor") else ("!!" if e.get("kind")
+                                            in _LOUD_KINDS else "  ")
+        off = f" (clk{e['clock_offset']:+.3f}s)" \
+            if e.get("clock_offset") else ""
+        lines.append(
+            f"{mark} +{e['wall'] - t0:10.3f}s  [{e.get('role', '?'):>14}]"
+            f" {e.get('kind', '?'):<14} {e.get('detail', '')}{off}")
+    if len(events) > limit:
+        lines.append(f"... {len(events) - limit} more "
+                     f"(raise --limit, or narrow with --around)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Perfetto (Chrome trace-event) export
+# ---------------------------------------------------------------------------
+
+def to_perfetto(events: List[dict]) -> dict:
+    """Chrome trace-event JSON: one ``pid`` per role (named via metadata
+    events), instants for discrete events, complete-events ("X") for
+    sampled spans (duration known), counters for scalar series.
+    Timestamps are absolute epoch microseconds — Perfetto normalizes."""
+    roles = sorted({e.get("role", "?") for e in events})
+    pid_of = {r: i + 1 for i, r in enumerate(roles)}
+    trace: List[dict] = []
+    for role, pid in pid_of.items():
+        trace.append({"name": "process_name", "ph": "M", "pid": pid,
+                      "tid": 0, "args": {"name": role}})
+    for e in events:
+        pid = pid_of[e.get("role", "?")]
+        ts = e["wall"] * 1e6
+        if e["kind"] == "span":
+            dur_us = float(e["data"].get("value", 0.0)) * 1e3
+            trace.append({
+                "name": e["data"].get("span", e.get("tag", "span")),
+                "ph": "X", "ts": max(ts - dur_us, 0.0), "dur": dur_us,
+                "pid": pid, "tid": 1,
+                "args": {"trace_id": e["data"].get("trace_id", ""),
+                         "step": e["data"].get("step")},
+            })
+        elif e["kind"] == "scalar":
+            trace.append({
+                "name": e.get("tag", "scalar"), "ph": "C", "ts": ts,
+                "pid": pid, "tid": 0,
+                "args": {"value": float(e["data"].get("value", 0.0))},
+            })
+        elif e["kind"] == "histogram":
+            trace.append({
+                "name": e.get("tag", "histogram"), "ph": "C", "ts": ts,
+                "pid": pid, "tid": 0,
+                "args": {"p95": float(e["data"].get("p95") or 0.0)},
+            })
+        else:
+            trace.append({
+                "name": e.get("kind", "event"), "ph": "i", "ts": ts,
+                "pid": pid, "tid": 0, "s": "p",
+                "args": {"detail": e.get("detail", ""),
+                         "source": e.get("source", "")},
+            })
+    return {"traceEvents": trace, "displayTimeUnit": "ms",
+            "otherData": {"generator": "tools/timeline.py"}}
+
+
+def _jsonable(e: dict) -> dict:
+    out = {}
+    for k, v in e.items():
+        if isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        elif isinstance(v, np.ndarray):
+            out[k] = v.tolist()
+        elif isinstance(v, dict):
+            out[k] = _jsonable(v)
+        else:
+            out[k] = v
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/timeline.py",
+        description="merge blackbox/spans/quarantine/scalars into one "
+                    "clock-aligned incident timeline")
+    ap.add_argument("log_dir", help="run directory (logs/<refs>)")
+    ap.add_argument("--around", type=str, default=None, metavar="PATTERN",
+                    help="cut to ±window seconds around the first event "
+                         "matching PATTERN (substring over "
+                         "kind/tag/detail, e.g. EXIT_HUNG, rollback, "
+                         "quarantine)")
+    ap.add_argument("--window", type=float, default=30.0, metavar="SECS",
+                    help="half-width of the --around cut (default 30)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the event list as JSON")
+    ap.add_argument("--perfetto", type=str, default=None, metavar="OUT",
+                    help="write Chrome trace-event JSON (open in "
+                         "ui.perfetto.dev or chrome://tracing)")
+    ap.add_argument("--limit", type=int, default=200,
+                    help="max events in the text rendering")
+    ap.add_argument("--all-scalars", action="store_true",
+                    help="include EVERY scalar row (default: only the "
+                         "health/data planes)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.log_dir):
+        print(f"timeline: no such run dir {args.log_dir!r}",
+              file=sys.stderr)
+        return 2
+    events = build_timeline(args.log_dir, all_scalars=args.all_scalars)
+    if args.around:
+        events = filter_around(events, args.around, args.window)
+        if not events:
+            print(f"timeline: no event matches {args.around!r}",
+                  file=sys.stderr)
+            return 1
+    if args.perfetto:
+        doc = to_perfetto(events)
+        with open(args.perfetto, "w") as f:
+            json.dump(doc, f)
+        print(f"timeline: wrote {len(doc['traceEvents'])} trace events "
+              f"-> {args.perfetto}", file=sys.stderr)
+    if args.json:
+        print(json.dumps([_jsonable(e) for e in events]))
+    elif not args.perfetto:
+        print(render_text(events, limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
