@@ -35,9 +35,7 @@ from pytorch_distributed_tpu.factory import (
     published_params,
 )
 from pytorch_distributed_tpu.agents.clocks import GlobalClock, LearnerStats
-from pytorch_distributed_tpu.agents.param_store import (
-    ParamStore, make_flattener,
-)
+from pytorch_distributed_tpu.agents.param_store import ParamStore
 from pytorch_distributed_tpu.memory.device_replay import (
     DevicePerIngest, DeviceReplayIngest,
 )
@@ -52,7 +50,7 @@ from pytorch_distributed_tpu.utils import (
 from pytorch_distributed_tpu.utils.faults import FaultInjector
 from pytorch_distributed_tpu.utils.metrics import MetricsWriter
 from pytorch_distributed_tpu.utils.profiling import StepTimer
-from pytorch_distributed_tpu.utils.rngs import np_rng
+from pytorch_distributed_tpu.utils.rngs import np_rng, process_seed
 
 
 def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
@@ -305,7 +303,13 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             _pf = fused_per if is_device_per else fused
             perf_mon.register_jit("fused_step",
                                   getattr(_pf, "_cache_size", None))
-            _pkeys = jax.random.split(jax.random.PRNGKey(0), K + 1)[1:]
+            # seed-derived even though these keys only feed .lower()
+            # for the FLOP capture (apexlint rng-key-reuse: no literal-
+            # seed streams outside utils.rngs)
+            _pkeys = jax.random.split(
+                jax.random.PRNGKey(process_seed(opt.seed, "learner",
+                                                process_ind)),
+                K + 1)[1:]
             _pkeys = (_pkeys.reshape(K, *_pkeys.shape[1:]) if K > 1
                       else _pkeys[0])
             if is_device_per:

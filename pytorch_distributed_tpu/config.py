@@ -58,6 +58,47 @@ CONFIGS = [
 ]
 
 
+# ---------------------------------------------------------------------------
+# The env-knob declaration table (ISSUE 9).  Every TPU_APEX_* / *_FAULTS
+# environment variable the fleet reads MUST have a row here — (name,
+# where-read, one-line doc) — and a matching row in the knob tables of
+# README.md and TESTING.md.  ``tools/apexlint.py`` (knob-registry rule)
+# mechanically diffs this table against the env reads it finds in code
+# and against both docs, in BOTH directions: an undeclared read, a
+# declared-but-never-read row, and an undocumented knob are each
+# findings.  Names ending in ``*`` declare a family (per-field override
+# planes built from a prefix constant); ``*_FAULTS`` is the per-plane
+# fault-injection suffix family.  Plain string tuples on purpose: the
+# linter parses this literal via ast, no import.
+# ---------------------------------------------------------------------------
+KNOBS = (
+    ("TPU_APEX_PERF", "utils/perf.py",
+     "master perf-plane switch (shorthand for TPU_APEX_PERF_ENABLED)"),
+    ("TPU_APEX_PERF_*", "utils/perf.py",
+     "per-field PerfParams overrides (e.g. TPU_APEX_PERF_PEAK_FLOPS)"),
+    ("TPU_APEX_TRACE", "utils/tracing.py",
+     "chunk tracing on/off (default on; 0 ships plain chunks)"),
+    ("TPU_APEX_TRACE_SAMPLE", "utils/tracing.py",
+     "per-event span row sampling rate"),
+    ("TPU_APEX_QUARANTINE", "utils/health.py",
+     "process-wide ingest-quarantine kill switch"),
+    ("TPU_APEX_HEALTH_*", "utils/health.py",
+     "per-field HealthParams overrides (e.g. TPU_APEX_HEALTH_HANG_DEADLINE)"),
+    ("TPU_APEX_PROFILE", "utils/profiling.py",
+     "directory for TensorBoard-viewable device traces"),
+    ("TPU_APEX_BLACKBOX_DIR", "utils/flight_recorder.py",
+     "blackbox dump directory, exported to spawn children"),
+    ("TPU_APEX_RUN_ID", "utils/flight_recorder.py",
+     "run id stamped on blackbox dumps + quarantine files"),
+    ("DCN_FAULTS_*", "utils/faults.py",
+     "wire-role fault specs (DCN_FAULTS_CLIENT / DCN_FAULTS_GATEWAY)"),
+    ("*_FAULTS", "utils/faults.py",
+     "per-plane fault specs (CKPT_/FEEDER_/LEARNER_/ACTOR_FAULTS)"),
+    ("DCN_IDLE_DEADLINE", "parallel/dcn.py",
+     "gateway idle-connection reap deadline, seconds"),
+)
+
+
 def _default_refs() -> str:
     """Run signature ``{machine}_{timestamp}`` keying checkpoints and logs
     (reference utils/options.py:37-51)."""

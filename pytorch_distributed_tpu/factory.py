@@ -26,7 +26,7 @@ from pytorch_distributed_tpu.envs.atari import AtariEnv
 from pytorch_distributed_tpu.memory import (
     PrioritizedReplay, SharedReplay,
 )
-from pytorch_distributed_tpu.memory.feeder import QueueFeeder, QueueOwner
+from pytorch_distributed_tpu.memory.feeder import QueueOwner
 
 # ---------------------------------------------------------------------------
 # Component dicts (reference utils/factory.py:22-43)

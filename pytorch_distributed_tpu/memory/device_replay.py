@@ -47,6 +47,17 @@ class ReplayState(NamedTuple):
     fill: jax.Array       # int32 number of valid rows
 
 
+# single-owner declaration for the module-level ring mutators
+# (apexlint single-owner rule): the functional ring writes may only be
+# composed into programs by the replay backends themselves and the
+# fused rollout (models/policies emit="replay") — any other caller is
+# a second writer racing the cursor
+__apex_fn_owners__ = {
+    "ring_write": ("memory.",),
+    "ring_write_masked": ("memory.", "models.policies"),
+}
+
+
 def ring_write(state, chunk: Transition, capacity: int):
     """Write a chunk at the cursor of ANY ring state carrying the six-array
     schema plus pos/fill (ReplayState, and device_per.py's PerReplayState).
@@ -355,6 +366,11 @@ class DeviceReplayIngest:
     them with one host->device transfer each; partial chunks stay pending
     until filled.
     """
+
+    # single-owner declaration (apexlint): the learner process owns the
+    # HBM ring's ingest; actors can only reach it through make_feeder()
+    __apex_mutators__ = ("drain",)
+    __apex_owner__ = ("agents.learner", "memory.")
 
     def __init__(self, capacity: int, state_shape: Tuple[int, ...],
                  action_shape: Tuple[int, ...] = (),

@@ -40,9 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributed_tpu.memory.device_replay import round_capacity
-from pytorch_distributed_tpu.memory.sequence_replay import (
-    Segment, SegmentBatch,
-)
+from pytorch_distributed_tpu.memory.sequence_replay import SegmentBatch
 
 
 class SegmentChunk(NamedTuple):
@@ -334,6 +332,10 @@ class DeviceSequenceIngest:
     capacity / replay.build_fused_step / replay.beta), so the learner's
     fused-priority hot loop needs no sequence-specific branch.
     """
+
+    # single-owner declaration (apexlint): learner-only ingest pump
+    __apex_mutators__ = ("drain",)
+    __apex_owner__ = ("agents.learner", "memory.")
 
     def __init__(self, capacity: int, seq_len: int,
                  state_shape: Tuple[int, ...], lstm_dim: int,

@@ -17,7 +17,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import queue as _queue
 import time
-from typing import Any, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -171,6 +171,13 @@ class QueueOwner:
 
     Delegates the sampling surface; ``drain()`` must run on the owner
     process (the learner calls it before every sample)."""
+
+    # single-owner declaration (apexlint single-owner rule): only the
+    # learner role — and this module's own checkpoint path — may pump
+    # the ingest boundary; a second drainer corrupts fill accounting
+    # and bypasses the quarantine validator's per-source counters
+    __apex_mutators__ = ("drain",)
+    __apex_owner__ = ("agents.learner", "memory.feeder")
 
     def __init__(self, memory, max_queue_chunks: int = 4096):
         self.memory = memory

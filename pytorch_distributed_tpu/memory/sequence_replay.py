@@ -59,6 +59,22 @@ class SegmentBatch(NamedTuple):
     index: np.ndarray      # (B,) rows, for priority write-back
 
 
+class _BuilderStep(NamedTuple):
+    """One pushed actor step held by SegmentBuilder before emit.  Named
+    fields on purpose (apexlint schema-contract): assembly used to
+    positional-index raw 8-tuples, which silently misread every row the
+    day the prov column landed at index 7."""
+
+    obs: np.ndarray
+    action: int
+    reward: float
+    terminal: bool
+    next_obs: np.ndarray
+    c: np.ndarray
+    h: np.ndarray
+    prov: Optional[np.ndarray]
+
+
 class SegmentBuilder:
     """Per-env online segment assembly with overlap.
 
@@ -87,7 +103,7 @@ class SegmentBuilder:
         self.state_dtype = np.dtype(state_dtype)
         self.pack_frames = int(pack_frames)
         self._checked_sliding = False  # one-time invariant check on emit
-        self._steps: List[tuple] = []  # (obs, a, r, term, next_obs, c, h)
+        self._steps: List[_BuilderStep] = []
 
     def push(self, obs, action, reward, terminal, next_obs,
              carry: Tuple[np.ndarray, np.ndarray],
@@ -104,10 +120,12 @@ class SegmentBuilder:
         if episode_end is None:
             episode_end = bool(terminal)
         c, h = carry
-        self._steps.append((
-            np.asarray(obs), int(action), float(reward), bool(terminal),
-            np.asarray(next_obs), np.asarray(c, np.float32).copy(),
-            np.asarray(h, np.float32).copy(), prov))
+        self._steps.append(_BuilderStep(
+            obs=np.asarray(obs), action=int(action),
+            reward=float(reward), terminal=bool(terminal),
+            next_obs=np.asarray(next_obs),
+            c=np.asarray(c, np.float32).copy(),
+            h=np.asarray(h, np.float32).copy(), prov=prov))
         out: List[Segment] = []
         if episode_end:
             out.append(self._emit(len(self._steps)))
@@ -122,29 +140,29 @@ class SegmentBuilder:
     def _emit(self, n: int) -> Segment:
         T = self.T
         steps = self._steps[:n]
-        obs0 = steps[0][0]
+        obs0 = steps[0].obs
         action = np.zeros(T, np.int32)
         reward = np.zeros(T, np.float32)
         terminal = np.zeros(T, np.float32)
         mask = np.zeros(T, np.float32)
-        for t, (o, a, r, term, nxt, _c, _h, _p) in enumerate(steps):
-            action[t] = a
-            reward[t] = r
-            terminal[t] = float(term)
+        for t, s in enumerate(steps):
+            action[t] = s.action
+            reward[t] = s.reward
+            terminal[t] = float(s.terminal)
             mask[t] = 1.0
         if self.pack_frames:
             obs = self._emit_packed(steps, n)
         else:
             obs = np.zeros((T + 1, *obs0.shape), dtype=self.state_dtype)
             for t, s in enumerate(steps):
-                obs[t] = s[0]
-            obs[n] = steps[n - 1][4]  # bootstrap observation
+                obs[t] = s.obs
+            obs[n] = steps[n - 1].next_obs  # bootstrap observation
             # pad slots keep the bootstrap obs so scans stay shape-static
             for t in range(n + 1, T + 1):
                 obs[t] = obs[n]
         return Segment(obs=obs, action=action, reward=reward,
                        terminal=terminal, mask=mask,
-                       c0=steps[0][5], h0=steps[0][6], prov=steps[0][7])
+                       c0=steps[0].c, h0=steps[0].h, prov=steps[0].prov)
 
     def _emit_packed(self, steps, n: int) -> np.ndarray:
         """De-duplicated frame sequence (T+C, H, W): frames [0, C) are
@@ -154,7 +172,7 @@ class SegmentBuilder:
         clamps to <= n_valid, so reconstructed pad stacks are never
         read)."""
         C, T = self.pack_frames, self.T
-        obs0 = steps[0][0]
+        obs0 = steps[0].obs
         assert obs0.shape[0] == C, (
             f"pack_frames={C} but stacked obs has {obs0.shape[0]} channels")
         if not self._checked_sliding and n >= 2:
@@ -164,7 +182,7 @@ class SegmentBuilder:
             # channels — check the invariant once, on the first real
             # segment, at negligible cost.
             self._checked_sliding = True
-            assert np.array_equal(steps[1][0][:-1], steps[0][0][1:]), (
+            assert np.array_equal(steps[1].obs[:-1], steps[0].obs[1:]), (
                 "pack_frames set but observations are not a sliding "
                 "frame-stack (obs[t][:-1] != obs[t-1][1:]); disable "
                 "packing for this env")
@@ -173,14 +191,15 @@ class SegmentBuilder:
             # an env wrapper handing back e.g. the post-reset observation
             # as next_obs would silently store a wrong bootstrap frame at
             # truncation-style segment ends (advisor finding, round 3)
-            assert np.array_equal(steps[0][4][:-1], steps[0][0][1:]), (
+            assert np.array_equal(steps[0].next_obs[:-1],
+                                  steps[0].obs[1:]), (
                 "pack_frames set but next_obs does not slide from obs "
                 "(next_obs[:-1] != obs[1:]); disable packing for this env")
         frames = np.zeros((T + C, *obs0.shape[1:]), dtype=self.state_dtype)
         frames[:C] = obs0
         for t in range(1, n):
-            frames[C - 1 + t] = steps[t][0][-1]
-        frames[C - 1 + n] = steps[n - 1][4][-1]  # bootstrap newest frame
+            frames[C - 1 + t] = steps[t].obs[-1]
+        frames[C - 1 + n] = steps[n - 1].next_obs[-1]  # bootstrap frame
         for t in range(n + 1, T + 1):
             frames[C - 1 + t] = frames[C - 1 + n]
         return frames

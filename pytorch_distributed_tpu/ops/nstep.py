@@ -29,7 +29,7 @@ Two implementations:
 from __future__ import annotations
 
 from collections import deque
-from typing import Iterator, List, Optional
+from typing import List
 
 import numpy as np
 

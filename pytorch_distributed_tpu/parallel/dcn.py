@@ -154,7 +154,17 @@ def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
 # experience chunk encoding: columnar, no pickle
 # ---------------------------------------------------------------------------
 
-_FIELDS = ("state0", "action", "reward", "gamma_n", "state1", "terminal1")
+# the six replay columns come from the ONE schema declaration
+# (utils.experience.REPLAY_FIELDS) — a re-typed copy here would drift
+# silently when a column lands (apexlint schema-contract)
+_FIELDS = experience.REPLAY_FIELDS
+
+# Everything encode_chunk may put on the wire / decode_chunk may read:
+# the declared wire schema apexlint checks the codec against.  Extending
+# the wire format means extending this tuple FIRST (and keeping decode
+# tolerant of peers that don't ship the new column yet).
+WIRE_COLUMNS = experience.REPLAY_FIELDS + (
+    "priority", "priority_ok", "prov", "trace_id", "trace_born")
 
 
 def encode_chunk(items: List[Tuple[Transition, Optional[float]]]) -> bytes:

@@ -398,6 +398,10 @@ class ChunkValidator:
         self.num_actions = num_actions
         self.checked = 0
         self.rejected = 0
+        # Segment rows carry (T+1, *state_shape) (or frame-packed)
+        # observations — latched from the first row, never compared to
+        # the memory's PER-STEP state_shape
+        self._seg_obs_shape: Optional[Tuple[int, ...]] = None
 
     @classmethod
     def for_memory(cls, memory) -> "ChunkValidator":
@@ -408,6 +412,14 @@ class ChunkValidator:
         if priority is not None and (
                 not _finite_scalar(priority) or float(priority) < 0.0):
             return f"invalid priority {priority!r}"
+        if not hasattr(t, "state0"):
+            # R2D2 Segment row (memory/sequence_replay.py): vector
+            # fields per step, no six-column schema.  Until this branch
+            # the validator scalar-checked t.reward — a (T,) array —
+            # and crashed the learner's first drain on every sequence
+            # topology with quarantine active (found driving config 13
+            # under the ISSUE-9 verification pass).
+            return self._check_segment(t)
         for name in ("reward", "gamma_n", "terminal1"):
             if not _finite_scalar(getattr(t, name)):
                 return f"non-finite {name}"
@@ -423,6 +435,41 @@ class ChunkValidator:
             elif arr.dtype != self.state_dtype:
                 return (f"{name} dtype {arr.dtype} != "
                         f"expected {self.state_dtype}")
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                return f"non-finite {name}"
+        a = np.asarray(t.action)
+        if a.dtype.kind == "f" and not np.isfinite(a).all():
+            return "non-finite action"
+        if (self.num_actions is not None and a.dtype.kind in "iu"
+                and a.size and not ((a >= 0) & (a < self.num_actions)).all()):
+            return f"action out of range [0, {self.num_actions})"
+        return None
+
+    def _check_segment(self, t) -> Optional[str]:
+        """Segment-row validation: finiteness over the per-step vector
+        fields, obs shape/dtype drift latched from the first row (a
+        segment's obs is the whole window — (T+1, *state_shape), or the
+        frame-packed (T+C, H, W) — so the memory's per-step
+        ``state_shape`` must not be compared against it)."""
+        for name in ("reward", "terminal", "mask"):
+            arr = np.asarray(getattr(t, name, 0.0))
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                return f"non-finite {name}"
+        obs = np.asarray(t.obs)
+        if self._seg_obs_shape is None:
+            self._seg_obs_shape = obs.shape
+        elif obs.shape != self._seg_obs_shape:
+            return (f"obs shape {obs.shape} != "
+                    f"expected {self._seg_obs_shape}")
+        if self.state_dtype is None:
+            self.state_dtype = obs.dtype
+        elif obs.dtype != self.state_dtype:
+            return (f"obs dtype {obs.dtype} != "
+                    f"expected {self.state_dtype}")
+        if obs.dtype.kind == "f" and not np.isfinite(obs).all():
+            return "non-finite obs"
+        for name in ("c0", "h0"):
+            arr = np.asarray(getattr(t, name, 0.0))
             if arr.dtype.kind == "f" and not np.isfinite(arr).all():
                 return f"non-finite {name}"
         a = np.asarray(t.action)
@@ -470,6 +517,13 @@ class QuarantineStore:
     the store only counts — a poisoning actor must not fill the disk
     before the supervisor reacts."""
 
+    # single-owner declaration (apexlint): quarantine diversion happens
+    # at the declared ingest boundaries only — QueueOwner.drain, the
+    # device ingest drains, and the DCN gateway's per-slot validator;
+    # a caller elsewhere would hide data-loss from those counters
+    __apex_mutators__ = ("put",)
+    __apex_owner__ = ("memory.", "parallel.dcn", "utils.health")
+
     def __init__(self, source: str, max_files: int = 64):
         self.source = source
         self.max_files = max_files
@@ -506,8 +560,18 @@ class QuarantineStore:
         from pytorch_distributed_tpu.utils.tracing import format_trace_id
 
         cols: Dict[str, np.ndarray] = {}
-        for f in REPLAY_FIELDS:
-            vals = [np.asarray(getattr(t, f)) for t, _p, _r in rejected]
+        # transition rows dump the six replay columns; Segment rows
+        # (sequence topologies) dump their own schema — the validator
+        # now rejects segments too, and put() must not assume the
+        # six-column shape (it crashed on the first quarantined
+        # segment before this branch)
+        first = rejected[0][0]
+        fields = (REPLAY_FIELDS if hasattr(first, "state0")
+                  else tuple(f for f in getattr(first, "_fields", ())
+                             if f != "prov"))
+        for f in fields:
+            vals = [np.asarray(getattr(t, f, np.zeros(0)))
+                    for t, _p, _r in rejected]
             try:
                 cols[f] = np.stack(vals)
             except ValueError:  # shape-drifted offenders can't stack
@@ -551,6 +615,11 @@ class QuarantineStore:
 # source, aggregated counters for the T_STATUS health plane
 _q_lock = threading.Lock()
 _q_stores: Dict[str, QuarantineStore] = {}
+
+
+# factory → owning-class mapping for apexlint's receiver resolution:
+# ``get_quarantine(...).put(...)`` is a QuarantineStore mutation
+__apex_factories__ = {"get_quarantine": "QuarantineStore"}
 
 
 def get_quarantine(source: str, max_files: int = 64) -> QuarantineStore:

@@ -302,6 +302,44 @@ class TestChunkValidator:
         out, bad = v.filter([_transition(action=3), _transition(action=7)])
         assert len(out) == 1 and "range" in bad[0][2]
 
+    # -- Segment rows (R2D2 sequence topologies) ------------------------
+
+    def _segment(self, T=4, reward_nan_at=None, obs_shape=(5, 3)):
+        from pytorch_distributed_tpu.memory.sequence_replay import Segment
+
+        reward = np.zeros(T, np.float32)
+        if reward_nan_at is not None:
+            reward[reward_nan_at] = np.nan
+        return Segment(
+            obs=np.zeros(obs_shape, np.float32),
+            action=np.zeros(T, np.int32), reward=reward,
+            terminal=np.zeros(T, np.float32),
+            mask=np.ones(T, np.float32),
+            c0=np.zeros(2, np.float32), h0=np.zeros(2, np.float32))
+
+    def test_segment_rows_validate_instead_of_crashing(self):
+        """Regression (found driving config 13 under ISSUE 9): the
+        validator scalar-checked Segment.reward — a (T,) array — and
+        raised ValueError on the learner's FIRST drain of every
+        sequence topology with quarantine active.  The per-step
+        state_shape a SequenceReplay advertises must also never be
+        compared against the segment's whole-window obs."""
+        v = health.ChunkValidator(state_shape=(3,),
+                                  state_dtype=np.float32)
+        out, bad = v.filter([(self._segment(), 1.0),
+                             (self._segment(), None)])
+        assert len(out) == 2 and bad == []
+
+    def test_segment_nonfinite_and_drift_rejected(self):
+        v = health.ChunkValidator()
+        out, bad = v.filter([
+            (self._segment(), 1.0),
+            (self._segment(reward_nan_at=2), 1.0),   # NaN reward step
+            (self._segment(obs_shape=(6, 3)), 1.0),  # window drift
+        ])
+        assert len(out) == 1 and len(bad) == 2
+        assert "reward" in bad[0][2] and "shape" in bad[1][2]
+
 
 class TestQuarantineStore:
     def test_writes_npz_with_reason_and_trace(self, tmp_path):
@@ -320,6 +358,29 @@ class TestQuarantineStore:
         for _ in range(5):
             st.put([(*_transition(np.nan), "r")])
         assert st.files == 2 and st.count == 5
+
+    def test_segment_rows_quarantine_without_crashing(self):
+        """Companion to the validator segment fix: put() must dump the
+        SEGMENT schema, not getattr the six transition columns (that
+        crashed the drain on the first rejected segment)."""
+        from pytorch_distributed_tpu.memory.sequence_replay import (
+            Segment,
+        )
+
+        seg = Segment(obs=np.zeros((5, 3), np.float32),
+                      action=np.zeros(4, np.int32),
+                      reward=np.full(4, np.nan, np.float32),
+                      terminal=np.zeros(4, np.float32),
+                      mask=np.ones(4, np.float32),
+                      c0=np.zeros(2, np.float32),
+                      h0=np.zeros(2, np.float32))
+        st = health.get_quarantine("seq-src")
+        path = st.put([(seg, 1.0, "non-finite reward")])
+        assert path and os.path.exists(path)
+        with np.load(path) as z:
+            assert z["obs"].shape == (1, 5, 3)
+            assert np.isnan(z["reward"]).any()
+            assert "state0" not in z.files
 
     def test_shape_drifted_offenders_still_quarantine(self):
         st = health.get_quarantine("drift")

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Pre-PR gate (ISSUE 9): chain the whole tool layer — the lint plane
+# (invariant rules + generic pass), the seconds-scale smoke bench, and
+# the schema-aware regression gate.  Exit nonzero on the first failing
+# stage.  TESTING.md "Static-analysis gate" documents the workflow.
+#
+#   tools/check.sh                 # full gate
+#   APEXLINT_ONLY=1 tools/check.sh # lint only (noisy-host escape hatch)
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+tmp="$(mktemp -d)"
+trap 'rm -rf "$tmp"' EXIT
+
+echo "== stage 1: apexlint (invariant rules + generic pass) =="
+python tools/apexlint.py pytorch_distributed_tpu tools --json \
+    > "$tmp/apexlint.json"
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+    # exit 2 = usage/config error (malformed baseline, unknown rule):
+    # the real message is already on stderr and no JSON was written
+    if [ "$lint_rc" -eq 2 ]; then
+        echo "apexlint: CONFIG ERROR (see the message above — likely"
+        echo "tools/apexlint_baseline.json or the invocation)"
+        exit "$lint_rc"
+    fi
+    python - "$tmp/apexlint.json" <<'EOF' || true
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+for f in d["findings"]:
+    print(f"  {f['path']}:{f['line']} · {f['rule']} · {f['message']}")
+for e in d["stale_baseline"]:
+    print(f"  stale baseline: {e['rule']} at {e['path']}")
+EOF
+    echo "apexlint: FAIL (fix the findings or baseline them with a"
+    echo "justification in tools/apexlint_baseline.json)"
+    exit "$lint_rc"
+fi
+echo "apexlint: PASS ($(python -c "import json,sys;d=json.load(open('$tmp/apexlint.json'));print(f\"{d['files']} files, {d['baselined']} baselined\")"))"
+
+if [ "${APEXLINT_ONLY:-0}" = "1" ]; then
+    echo "APEXLINT_ONLY=1: skipping bench stages"
+    exit 0
+fi
+
+echo "== stage 2: bench --smoke =="
+if ! python bench.py --smoke > "$tmp/smoke.json"; then
+    echo "bench --smoke: FAIL"
+    exit 1
+fi
+echo "bench --smoke: PASS"
+
+echo "== stage 3: bench_gate vs BENCH_SMOKE_BASELINE.json =="
+# generous smoke tolerance: this stage pins the pipeline on any host;
+# same-machine perf gating uses the recorded history (TESTING.md)
+if ! python tools/bench_gate.py "$tmp/smoke.json" \
+        --against BENCH_SMOKE_BASELINE.json --tol smoke=0.9 \
+        --record BENCH_HISTORY.jsonl; then
+    echo "bench_gate: FAIL"
+    exit 1
+fi
+echo "bench_gate: PASS"
+echo "pre-PR gate: ALL STAGES PASS"
