@@ -1061,6 +1061,144 @@ def bench_provenance_overhead(windows: int = 5,
     return {"provenance_overhead": out}
 
 
+def bench_metrics_overhead(windows: int = 6,
+                           updates_per_window: int = 512,
+                           smoke: bool = False) -> dict:
+    """Mission-control plane cost (ISSUE 10 acceptance): the fused
+    dqn-mlp learner loop with its per-window stats rows (the bare
+    stats cadence both legs pay) vs the same loop with the FULL
+    telemetry path live — a MissionControl tailing + ingesting the run
+    dir and evaluating an alert rule per window (the gateway-host leg),
+    plus a MetricsPusher tailing the same stream and pushing the
+    window's scalar deltas to a local gateway over T_METRICS (the
+    fleet-host leg, including its wire round-trip and the gateway-side
+    aggregator ingest).  Both legs land in ONE number because a real
+    fleet host pays one or the other; paying both here is the
+    conservative bound.  Everything runs on the stats cadence — the
+    dispatch hot loop itself is untouched by the plane — so the
+    acceptance bar is ``metrics_overhead_frac`` < 0.02 of median step
+    time (the bench_gate absolute overhead band).
+
+    ``smoke=True`` shrinks windows/iters to seconds-scale for CI; the
+    measurement logic is identical."""
+    import jax
+
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.config import AlertParams, MetricsParams
+    from pytorch_distributed_tpu.parallel.dcn import DcnGateway
+    from pytorch_distributed_tpu.utils import telemetry
+    from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+
+    B, K = 32, 8
+    if smoke:
+        # windows stay SECONDS-wide even in smoke: the plane's cost is
+        # per-cadence, so a too-narrow window measures timer noise, not
+        # the plane (a 128-update window is ~0.3 s on this class of
+        # host — one 15 ms scheduler hiccup reads as 5% "overhead")
+        windows = min(windows, 4)
+        updates_per_window = min(updates_per_window, 384)
+    fused, state0, ring = _mlp_fused_program(B, K)
+    key = jax.random.PRNGKey(0)
+
+    def keymat():
+        nonlocal key
+        key, sub = jax.random.split(key)
+        return jax.random.split(sub, K)
+
+    # ONE compile shared by both legs (donate=False keeps state0
+    # reusable): the measurement is of the telemetry plane, not XLA
+    compiled = fused.lower(state0, ring.state, keymat()).compile()
+
+    log_dir = tempfile.mkdtemp(prefix="bench_metrics_")
+    writer = MetricsWriter(log_dir, enable_tensorboard=False,
+                           role="learner")
+    # gateway-side aggregator behind a REAL gateway socket: the push
+    # leg pays the wire, the decode, and the ingest
+    sink = telemetry.MissionControl(
+        None, MetricsParams(enabled=True), AlertParams(enabled=False))
+    gw = DcnGateway(ParamStore(4), GlobalClock(), ActorStats(),
+                    put_chunk=lambda items: None,
+                    host="127.0.0.1", port=0,
+                    metrics_sink=sink.ingest_remote)
+    # local leg: tail + ingest + one quiet-threshold rule pass
+    mission = telemetry.MissionControl(
+        log_dir, MetricsParams(enabled=True),
+        AlertParams(rules="slow: learner/updates_per_s < 1 for 60s"))
+    pusher = telemetry.MetricsPusher(("127.0.0.1", gw.port), log_dir,
+                                     MetricsParams(enabled=True))
+
+    state = state0
+    for _ in range(5):
+        state, metrics = compiled(state, ring.state, keymat())
+    float(jax.device_get(metrics["learner/critic_loss"]))
+    pusher.push_once()  # offset handshake + pipe warmup, outside timing
+
+    # INTERLEAVED windows (bare, instrumented, bare, ...): this host
+    # class drifts ±10% between back-to-back runs (VM steal/freq
+    # noise), which back-to-back legs read as fake overhead; pairing
+    # windows makes each leg sample the same host weather.  The GATE
+    # number is NOT the rate difference (a difference of two noisy
+    # medians reads scheduler hiccups as multi-% "overhead" on a
+    # loaded 2-vCPU host — observed flaking the tier-1 smoke gate):
+    # the plane runs on a seconds-scale CADENCE, so its honest cost is
+    # the DIRECTLY TIMED tail+ingest+alert-eval+push work as a
+    # fraction of the wall span it amortizes over — one cadence every
+    # other ~1 s window ≈ the production poll_s/push_s density.  The
+    # A/B rates stay in the output as context.
+    iters = max(updates_per_window // K, 2)
+    rates = {False: [], True: []}
+    plane_s = 0.0
+    total_s = 0.0
+    mstep = 0
+    for w in range(windows * 2):
+        instrumented = bool(w % 2)
+        keysets = [keymat() for _ in range(iters)]
+        jax.block_until_ready(keysets[-1])
+        t0 = time.perf_counter()
+        for ks in keysets:
+            state, metrics = compiled(state, ring.state, ks)
+        mstep += iters * K
+        # the bare stats cadence BOTH legs pay: one scalar flush per
+        # window (what agents/learner.py does)
+        writer.scalars({"learner/updates_per_s": float(iters * K),
+                        "learner/ingest_queue_util": 0.0}, step=mstep)
+        if instrumented:
+            tp = time.perf_counter()
+            mission.poll()        # tail + ingest + alert eval
+            pusher.push_once()    # T_METRICS push of the deltas
+            plane_s += time.perf_counter() - tp
+        float(jax.device_get(metrics["learner/critic_loss"]))
+        dt = time.perf_counter() - t0
+        total_s += dt
+        rates[instrumented].append(iters * K / dt)
+    writer.close()
+    pushed_rows = pusher.pushed_rows
+    mission.stop()
+    gw.close()
+
+    bare = float(np.median(rates[False]))
+    instr = float(np.median(rates[True]))
+    frac = plane_s / total_s if total_s > 0 else None
+    out = {
+        "updates_per_sec_bare": round(bare, 2),
+        "updates_per_sec_metrics": round(instr, 2),
+        # the gate number: cadence work / wall span it amortizes over
+        "metrics_overhead_frac": (round(frac, 4)
+                                  if frac is not None else None),
+        "plane_ms_per_cadence": round(plane_s / max(windows, 1) * 1e3,
+                                      2),
+        "pushed_rows": int(pushed_rows),
+        "steps_per_dispatch": K,
+        "batch_size": B,
+        "geometry": "smoke-mlp" if smoke else "mlp",
+    }
+    print(f"[bench_metrics_overhead] {out}", file=sys.stderr, flush=True)
+    return {"metrics_overhead": out}
+
+
 def bench_smoke(updates: int = 384) -> dict:
     """Seconds-scale, CPU-safe bench for CI gating (ISSUE 6 satellite):
     the dqn-mlp learner program fused over a small uniform HBM-style
@@ -1541,7 +1679,7 @@ def main() -> None:
     ap.add_argument("--mode", choices=("micro", "e2e", "both", "families",
                                        "sampler", "act", "actor",
                                        "health", "perf", "device_env",
-                                       "provenance"),
+                                       "provenance", "metrics"),
                     default="both")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale CPU-safe bench (the dqn-mlp "
@@ -1579,6 +1717,10 @@ def main() -> None:
             dev["fused"]["32"]["linear_frames_per_sec"]
         result["smoke"]["device_env_host_frames_per_sec"] = \
             dev["ladder"]["32"]["host_frames_per_sec"]
+        # ISSUE-10 telemetry-plane overhead rides the smoke output so
+        # the pre-PR gate holds the <2% band continuously (additive
+        # key — existing keys keep their meaning, so no schema bump)
+        result.update(bench_metrics_overhead(smoke=True))
         out = {
             "bench_schema": 4,
             "metric": "smoke_updates_per_sec",
@@ -1606,6 +1748,8 @@ def main() -> None:
         result.update(bench_perf_overhead())
     if args.mode in ("both", "provenance"):
         result.update(bench_provenance_overhead())
+    if args.mode in ("both", "metrics"):
+        result.update(bench_metrics_overhead())
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))

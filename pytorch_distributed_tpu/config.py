@@ -96,6 +96,14 @@ KNOBS = (
      "per-plane fault specs (CKPT_/FEEDER_/LEARNER_/ACTOR_FAULTS)"),
     ("DCN_IDLE_DEADLINE", "parallel/dcn.py",
      "gateway idle-connection reap deadline, seconds"),
+    ("TPU_APEX_METRICS", "utils/telemetry.py",
+     "mission-control metrics plane switch (shorthand for "
+     "TPU_APEX_METRICS_ENABLED)"),
+    ("TPU_APEX_METRICS_*", "utils/telemetry.py",
+     "per-field MetricsParams overrides (e.g. "
+     "TPU_APEX_METRICS_OPENMETRICS, TPU_APEX_METRICS_PUSH_S)"),
+    ("TPU_APEX_ALERT_*", "utils/telemetry.py",
+     "per-field AlertParams overrides (e.g. TPU_APEX_ALERT_RULES)"),
 )
 
 
@@ -463,6 +471,71 @@ class PerfParams:
 
 
 @dataclass
+class MetricsParams:
+    """Mission-control metrics-plane knobs (utils/telemetry.py; no
+    reference equivalent — the reference has no fleet-level telemetry
+    at all).  Every field is env-overridable as
+    ``TPU_APEX_METRICS_<FIELD>`` via ``telemetry.resolve_metrics``
+    (bare ``TPU_APEX_METRICS=1`` maps to ``enabled``), the same
+    spawn-inheritance contract the health/perf planes use."""
+
+    # Master switch: aggregate every role's scalar stream into bounded
+    # fleet time series, evaluate the alert rules on the poll cadence,
+    # and serve ``alerts``/``series`` blocks on the gateway STATUS
+    # verb.  Off by default: the plane is one tail-read + rule pass
+    # per cadence, but it is an operator surface, not a training one.
+    enabled: bool = False
+    # Local tail-ingest + alert-evaluation cadence, seconds.
+    poll_s: float = 2.0
+    # Remote-host T_METRICS push cadence, seconds (the fleet actor
+    # hosts' MetricsPusher).
+    push_s: float = 5.0
+    # Retention tiers: raw points cover ``raw_span_s`` seconds (capped
+    # at ``raw_points`` per series); coarser 10 s / 60 s bucket tiers
+    # extend history without unbounded memory (SeriesRing docstring).
+    raw_span_s: float = 300.0
+    raw_points: int = 1024
+    # Distinct (tag, role) series bound — overflow is counted
+    # (``series_dropped``), never silent.
+    max_series: int = 512
+    # Points per series in the STATUS ``series`` block (fleet_top's
+    # sparklines; the block rides every STATUS reply, so keep it small).
+    series_points: int = 32
+    # Extra tags for the STATUS series block, comma-separated (the
+    # vital-sign defaults + rule tags are always included).
+    series_tags: str = ""
+    # Opt-in OpenMetrics/Prometheus text endpoint (stdlib HTTP, GET
+    # /metrics) on the aggregator host.
+    openmetrics: bool = False
+    openmetrics_port: int = 9108
+
+
+@dataclass
+class AlertParams:
+    """Declarative SLO/alert rules over the aggregated fleet series
+    (utils/telemetry.py AlertEngine).  Env-overridable as
+    ``TPU_APEX_ALERT_<FIELD>``; ``TPU_APEX_ALERT_RULES`` replaces the
+    whole rule set (``;``-separated DSL lines)."""
+
+    # Evaluate rules at all (the metrics plane can aggregate without
+    # alerting, e.g. for a pure-dashboard deployment).
+    enabled: bool = True
+    # The rule set, one DSL line per rule, ``;``-separated::
+    #
+    #   name: tag absent 120s            (absence/staleness)
+    #   name: tag > 100 for 60s          (threshold with dwell)
+    #   name: tag < 0.02 frac 0.5 over 300s   (windowed burn-rate)
+    #
+    # "" = telemetry.DEFAULT_RULES (learner-stall absence, staleness
+    # burn-rate, priority-ESS collapse).
+    rules: str = ""
+    # Seconds a firing rule must observe clean before it resolves
+    # (hysteresis against flapping series).  0 = resolve on the first
+    # clean evaluation.
+    resolve_s: float = 0.0
+
+
+@dataclass
 class ParallelParams:
     """TPU topology knobs — no reference equivalent (the reference is a
     single-node torch.multiprocessing program, SURVEY.md §2); this is where
@@ -536,6 +609,8 @@ class Options:
     parallel_params: ParallelParams = field(default_factory=ParallelParams)
     health_params: HealthParams = field(default_factory=HealthParams)
     perf_params: PerfParams = field(default_factory=PerfParams)
+    metrics_params: MetricsParams = field(default_factory=MetricsParams)
+    alert_params: AlertParams = field(default_factory=AlertParams)
 
     @property
     def model_dir(self) -> str:
@@ -625,14 +700,26 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
     # Route simple top-level overrides to the right sub-dataclass.
     for key, val in overrides.items():
         assert key not in selectors  # popped above
-        routed = False
+        hits = []
         for sub in ("env_params", "memory_params", "model_params",
                     "agent_params", "parallel_params", "health_params",
-                    "perf_params"):
+                    "perf_params", "metrics_params", "alert_params"):
             subobj = getattr(opt, sub)
             if hasattr(subobj, key):
-                setattr(subobj, key, val)
-                routed = True
+                hits.append((sub, subobj))
+        if len(hits) > 1:
+            # a field living on several sub-params ("enabled" is on the
+            # perf/metrics/alert planes): a bare override would silently
+            # flip every plane at once — refuse, name the candidates
+            raise ValueError(
+                f"ambiguous option {key!r}: lives on "
+                f"{', '.join(s for s, _ in hits)} — set the field "
+                f"directly (opt.<sub>.{key}) or use the plane's env "
+                f"knob (TPU_APEX_*)")
+        routed = False
+        for _sub, subobj in hits:
+            setattr(subobj, key, val)
+            routed = True
         if hasattr(opt, key):
             setattr(opt, key, val)
             routed = True

@@ -118,7 +118,18 @@ class FleetTopology(Topology):
             put_chunk=feed_queue_of(self.handles), port=port,
             local_actors=self.local_actors,
             health=self._health_snapshot,
-            profiler=self._profile_request)
+            profiler=self._profile_request,
+            metrics_sink=self._metrics_sink)
+
+    def _metrics_sink(self, payload: dict) -> int:
+        """T_METRICS provider: remote hosts' scalar batches land in the
+        mission-control aggregator (utils/telemetry.py).  Plane
+        disabled -> absorb nothing (the gateway replies accepted:0; the
+        pusher side only runs when ITS plane is enabled, so this is the
+        mixed-config case, not the steady state)."""
+        if self.mission is None:
+            return 0
+        return self.mission.ingest_remote(payload)
 
     def _profile_request(self, msg: dict) -> dict:
         """T_PROFILE provider (parallel/dcn.py): a bounded
@@ -242,6 +253,12 @@ class FleetTopology(Topology):
         psnap = perf.status_snapshot()
         if psnap:
             h["perf"] = psnap
+        # mission control (ISSUE 10): per-rule alert states + recent
+        # fleet series — fleet_top's alert panel/sparklines and the
+        # ``--json`` blocks CI asserts on come from HERE, not from the
+        # probe re-tailing metrics files itself
+        if self.mission is not None:
+            h.update(self.mission.status_block())
         return h
 
     def _worker_specs(self):
@@ -387,10 +404,24 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
         f"fleet num_actors={opt.num_actors}")
 
     from pytorch_distributed_tpu.factory import prebuild_native
-    from pytorch_distributed_tpu.utils import health
+    from pytorch_distributed_tpu.utils import health, telemetry
     from pytorch_distributed_tpu.utils.supervision import ProgressBoard
 
     prebuild_native(opt)  # once, before N workers race the same g++
+
+    # mission-control push leg (ISSUE 10): this host's actors write
+    # their scalar rows to the LOCAL log dir; when the metrics plane is
+    # on, a MetricsPusher tails that stream and ships scalar-window
+    # deltas to the learner-host aggregator over the sessionless
+    # T_METRICS verb, clock-offset-aligned — the fleet-level series
+    # cover remote hosts, not just the gateway host.
+    pusher = None
+    mparams = telemetry.resolve_metrics(opt.metrics_params)
+    if mparams.enabled:
+        phost, pport = coordinator.rsplit(":", 1)
+        pusher = telemetry.MetricsPusher((phost, int(pport)),
+                                         opt.log_dir, mparams)
+        pusher.start()
 
     # hang watchdog (health sentinel): per-slot liveness marks bumped by
     # the remote actors' RemoteClock; stale marks past hang_deadline get
@@ -442,6 +473,8 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
         for w in workers.values():
             w.join()
         bad = {ind: code for ind, code in thread_exits.items() if code}
+        if pusher is not None:
+            pusher.stop()  # final tail drain rides the stop
         if bad:
             raise RuntimeError(
                 f"actor host FAILED (thread backend): worker exit codes "
@@ -573,6 +606,8 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
         pending.clear()
     if prev_term is not None:
         signal.signal(signal.SIGTERM, prev_term)
+    if pusher is not None:
+        pusher.stop()  # final tail drain rides the stop
     return abandoned
 
 

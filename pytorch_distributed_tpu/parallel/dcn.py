@@ -118,6 +118,12 @@ T_PROFILE = 10  # JSON {seconds, label?, role?} -> T_PROFILE JSON reply
 #                (sessionless like T_STATUS: triggers a bounded XLA
 #                profiler window on the learner host and reports the
 #                trace directory back — tools/fleet_top.py --profile)
+T_METRICS = 11  # JSON {rows, offset?, host?} -> T_METRICS JSON reply
+#                (sessionless like T_STATUS, outside the fault plane:
+#                fleet hosts push batched scalar-window deltas into the
+#                learner-host aggregator on the stats cadence; the
+#                reply's ``wall`` lets the pusher estimate its clock
+#                offset NTP-style — utils/telemetry.MetricsPusher)
 
 _MAX_FRAME = 1 << 31  # 2 GiB — far above any chunk; rejects garbage lengths
 
@@ -292,7 +298,8 @@ class DcnGateway:
                  idle_deadline: Optional[float] = None,
                  faults: Optional[FaultInjector] = None,
                  health: Optional[Callable[[], dict]] = None,
-                 profiler: Optional[Callable[[dict], dict]] = None):
+                 profiler: Optional[Callable[[dict], dict]] = None,
+                 metrics_sink: Optional[Callable[[dict], int]] = None):
         self.param_store = param_store
         self.clock = clock
         self.actor_stats = actor_stats
@@ -312,6 +319,13 @@ class DcnGateway:
         # dir; no provider wired -> error reply, never a crash
         self._profiler = profiler
         self.profiles_served = 0
+        # T_METRICS sink (utils/telemetry.MissionControl.ingest_remote
+        # via the owning topology): receives one pushed batch dict and
+        # returns rows absorbed; no sink wired -> counted error reply,
+        # never a crash
+        self._metrics_sink = metrics_sink
+        self.metrics_batches = 0
+        self.metrics_rows = 0
         self._tracer = tracing.get_tracer("gateway")
         self._recorder = flight_recorder.get_recorder("gateway")
         self._born = time.monotonic()
@@ -407,6 +421,8 @@ class DcnGateway:
             "connections": self.connections,
             "chunks_in": self.chunks_in,
             "fenced": self.fenced,
+            "metrics_batches": self.metrics_batches,
+            "metrics_rows": self.metrics_rows,
             "frames_rejected": self.frames_rejected,
             "quarantined": dict(self.quarantined),
         }
@@ -534,12 +550,12 @@ class DcnGateway:
             with conn:
                 while not self._stop.is_set():
                     ftype, payload = _recv_frame(conn)
-                    if ftype not in (T_STATUS, T_PROFILE):
-                        # STATUS/PROFILE probes are outside the fault
-                        # plane: a monitor polling the gateway must
-                        # neither shift a deterministic drill's frame
-                        # schedule nor absorb a fault meant for session
-                        # traffic
+                    if ftype not in (T_STATUS, T_PROFILE, T_METRICS):
+                        # STATUS/PROFILE/METRICS probes are outside the
+                        # fault plane: a monitor polling the gateway
+                        # must neither shift a deterministic drill's
+                        # frame schedule nor absorb a fault meant for
+                        # session traffic
                         payload = self._faults.frame(payload)
                     if slot is not None:
                         # plain GIL-atomic write: heartbeat-age reads in
@@ -575,6 +591,31 @@ class DcnGateway:
                             ok=("error" not in reply),
                             seconds=msg.get("seconds"))
                         _send_frame(conn, T_PROFILE,
+                                    json.dumps(reply).encode())
+                    elif ftype == T_METRICS:
+                        # fleet-host scalar push, sessionless like
+                        # STATUS.  The reply always carries the
+                        # gateway's wall clock — the pusher's NTP-style
+                        # offset estimator reads it off the RPC
+                        # midpoint, which is what lets remote rows land
+                        # on the learner host's time axis.
+                        msg = self._json(payload) if payload else {}
+                        if self._metrics_sink is None:
+                            reply = {"accepted": 0,
+                                     "error": "no metrics sink wired "
+                                              "on this gateway"}
+                        else:
+                            try:
+                                n = int(self._metrics_sink(msg) or 0)
+                                reply = {"accepted": n}
+                                self.metrics_rows += n
+                            except Exception as e:  # noqa: BLE001
+                                reply = {"accepted": 0,
+                                         "error":
+                                         f"metrics sink failed: {e!r}"}
+                        self.metrics_batches += 1
+                        reply["wall"] = time.time()
+                        _send_frame(conn, T_METRICS,
                                     json.dumps(reply).encode())
                     elif ftype == T_EXP:
                         try:
@@ -800,6 +841,43 @@ def fetch_profile(address: Tuple[str, int], seconds: float = 3.0,
             return json.loads(payload.decode())
         except (ValueError, UnicodeDecodeError) as e:
             raise ConnectionError(f"undecodable PROFILE reply: {e}")
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def push_metrics(address: Tuple[str, int], rows: list,
+                 offset: Optional[float] = None,
+                 host: Optional[str] = None,
+                 timeout: float = 10.0) -> dict:
+    """One T_METRICS round-trip: push a batch of scalar rows (the
+    MetricsWriter JSONL schema — plain dicts) into the learner-host
+    aggregator.  Sessionless like ``fetch_status`` — no HELLO, no slot
+    claim — and OUTSIDE the fault-injection plane, so the telemetry
+    path never shifts a drill schedule.  ``offset`` is the pusher's
+    estimated clock offset to the gateway (seconds to ADD to this
+    host's walls); the reply carries ``accepted`` and the gateway's
+    ``wall`` for the next offset estimate
+    (utils/telemetry.MetricsPusher owns the estimator and cadence)."""
+    msg: Dict[str, Any] = {"rows": list(rows)}
+    if offset is not None:
+        msg["offset"] = float(offset)
+    if host is not None:
+        msg["host"] = str(host)
+    sock = socket.create_connection(address, timeout=timeout)
+    try:
+        sock.settimeout(timeout)
+        _send_frame(sock, T_METRICS, json.dumps(msg).encode())
+        rtype, payload = _recv_frame(sock)
+        if rtype != T_METRICS:
+            raise ConnectionError(
+                f"expected T_METRICS reply, got frame type {rtype}")
+        try:
+            return json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ConnectionError(f"undecodable METRICS reply: {e}")
     finally:
         try:
             sock.close()

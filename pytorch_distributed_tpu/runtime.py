@@ -146,6 +146,17 @@ class Topology:
         self.perf = perf.resolve(opt.perf_params)
         if self.perf.enabled:
             perf.export_env(self.perf)
+        # ---- mission control (ISSUE 10): fleet metrics aggregation +
+        # SLO/alert engine + opt-in OpenMetrics endpoint.  Built here
+        # (unstarted) so the fleet gateway's T_METRICS sink has a
+        # target from construction; run() starts/stops the poll thread.
+        from pytorch_distributed_tpu.utils import telemetry
+
+        self.metrics_params = telemetry.resolve_metrics(opt.metrics_params)
+        self.mission = None
+        if self.metrics_params.enabled:
+            self.mission = telemetry.MissionControl(
+                opt.log_dir, self.metrics_params, opt.alert_params)
         labels = ["learner", "evaluator-0"] + [
             f"actor-{i}" for i in range(opt.num_actors)]
         self.progress_board = ProgressBoard(labels)
@@ -260,6 +271,11 @@ class Topology:
         if self.inference_server is not None:
             # after _worker_specs wired the clients, before anyone acts
             self.inference_server.start()
+        if self.mission is not None:
+            # after the blackbox home is configured (alert transitions
+            # record into this process's rings), before the learner
+            # starts producing the rows it will aggregate
+            self.mission.start()
         try:
             self.progress_board.note_start("learner")
             run_learner = get_worker("learner", opt.agent_type)
@@ -276,6 +292,11 @@ class Topology:
                 # after the join: an actor draining its last tick may
                 # still be blocked in collect()
                 self.inference_server.stop()
+            if self.mission is not None:
+                # final tail drain + alert pass, then the writer closes;
+                # before _pre_close so a last T_METRICS push racing the
+                # gateway teardown still finds a live sink
+                self.mission.stop()
             # transports feeding learner_side must shut before its queue
             # closes (FleetTopology stops its DCN gateway here)
             self._pre_close()

@@ -176,14 +176,25 @@ class ScalarsTail:
     — next poll re-reads it complete), while a newline-terminated line
     that still fails to decode (a SIGKILL-torn line mid-file) is
     skipped for good.  A file that shrank (rotation, a fresh run
-    reusing the dir) resets the cursor to the start."""
+    reusing the dir) resets the cursor to the start.
 
-    def __init__(self, log_dir: str):
+    ``max_bytes`` bounds one poll's read (the T_METRICS push path,
+    utils/telemetry.MetricsPusher): a pusher that fell far behind — or
+    attached to an old, huge stream — catches up over several cadences
+    instead of encoding the whole backlog into one wire frame.  A
+    bounded read that lands mid-line simply resumes from the last
+    complete newline next poll; a single line LONGER than the bound
+    (impossible for well-formed scalar rows) is dropped rather than
+    livelocking the cursor."""
+
+    def __init__(self, log_dir: str, max_bytes: Optional[int] = None):
         self.path = os.path.join(log_dir, "scalars.jsonl")
         self._offset = 0
+        self._max_bytes = max_bytes
 
     def poll(self) -> List[dict]:
-        """All rows appended since the previous poll."""
+        """All rows appended since the previous poll (up to the
+        ``max_bytes`` read bound when one is set)."""
         try:
             with open(self.path, "rb") as f:
                 f.seek(0, os.SEEK_END)
@@ -191,11 +202,17 @@ class ScalarsTail:
                 if size < self._offset:
                     self._offset = 0  # truncated/rotated: start over
                 f.seek(self._offset)
-                data = f.read()
+                data = (f.read() if self._max_bytes is None
+                        else f.read(self._max_bytes))
         except OSError:
             return []
         end = data.rfind(b"\n")
         if end < 0:
+            if (self._max_bytes is not None
+                    and len(data) >= self._max_bytes):
+                # one line wider than the whole read bound: skip it or
+                # every future poll re-reads the same undecodable chunk
+                self._offset += len(data)
             return []  # only an unterminated tail so far — wait
         self._offset += end + 1
         out = []
@@ -208,6 +225,17 @@ class ScalarsTail:
             except (ValueError, UnicodeDecodeError):
                 continue  # torn mid-file line (kill); the rest is good
         return out
+
+
+def is_scalar_row(rec: dict) -> bool:
+    """True for plain scalar rows of the JSONL schema (module
+    docstring): a ``tag`` + numeric ``value`` and no distribution
+    ``kind``.  The telemetry aggregator (utils/telemetry.py) and the
+    T_METRICS push path admit only these — histogram/span/bucket rows
+    are already summarized at their writer."""
+    return (isinstance(rec, dict) and "tag" in rec
+            and isinstance(rec.get("value"), (int, float))
+            and rec.get("kind") in (None, "scalar"))
 
 
 def read_scalars(log_dir: str) -> List[dict]:

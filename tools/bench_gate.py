@@ -67,6 +67,7 @@ SPECS: List[Tuple[str, str, str]] = [
     ("perf_overhead.perf_overhead_frac", "lower_abs", "overhead"),
     ("provenance_overhead.provenance_overhead_frac", "lower_abs",
      "overhead"),
+    ("metrics_overhead.metrics_overhead_frac", "lower_abs", "overhead"),
     ("device_env.host_frames_per_sec", "higher", "device_env"),
     ("device_env.device_frames_per_sec", "higher", "device_env"),
     ("device_env.fused_frames_per_sec", "higher", "device_env"),

@@ -22,11 +22,24 @@ wire.  Exits nonzero on any invariant violation:
   and the gateway's ingest quarantine must divert ALL of them;
 - **stall mishandled** — one seeded actor freezes mid-run for several
   heartbeat intervals (the hang-adjacent stall): its session must ride
-  through on heartbeats, never end disconnected.
+  through on heartbeats, never end disconnected;
+- **alert contract broken** (ISSUE 10, with ``--learner-stall``): the
+  soak attaches a mission-control plane (utils/telemetry.py) fed by a
+  simulated learner's stats cadence and freezes that learner for a
+  window mid-run.  The ``learner/updates_per_s`` absence rule must
+  walk pending→firing during the stall and resolve after recovery;
+  an EXPECTED alert that never fires, an alert still unresolved at the
+  end, or any UNEXPECTED rule firing is each a violation — the alert
+  engine is drilled exactly like the session layer.  With ``--log-dir``
+  the run leaves the production artifact set (blackbox rings with the
+  alert transitions, ``alert/*`` scalar rows) so ``tools/timeline.py``
+  reconstructs the incident.
 
 Usage:
     python tools/chaos_soak.py --seconds 30 --actors 4 --seed 0
     python tools/chaos_soak.py --seconds 60 --restart-every 5
+    python tools/chaos_soak.py --seconds 10 --learner-stall 2.5 \
+        --learner-stall-at 3 --log-dir logs/soak
 
 The same ``SyntheticActor`` drives the deterministic chaos scenarios in
 tests/test_chaos.py; this entry point is the long-haul randomized
@@ -183,22 +196,67 @@ class SyntheticActor:
                         and not client.stop.is_set() else "stopped")
 
 
+# the drill rule set a --learner-stall soak runs: the absence rule the
+# stall MUST fire, plus a threshold rule that must stay quiet — the
+# unexpected-alert invariant needs a rule that could fire but shouldn't
+SOAK_ALERT_RULES = ("learner_stall: learner/updates_per_s absent 1.5s; "
+                    "learner_slow: learner/updates_per_s < 1 for 2s")
+
+
 def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
          restart_every: Optional[float] = 5.0,
          fault_rates: Optional[Dict[str, float]] = None,
          reconnect_timeout: float = 10.0,
          poison_every: int = 40,
+         learner_stall: float = 0.0, learner_stall_at: float = 3.0,
+         log_dir: Optional[str] = None, port: int = 0,
+         alert_rules: Optional[str] = None,
          verbose: bool = True) -> dict:
     """Run the randomized soak; returns a report dict whose
-    ``violations`` list is empty on a healthy session layer."""
+    ``violations`` list is empty on a healthy session layer (and, with
+    ``learner_stall`` > 0, a healthy alert plane — see module
+    docstring)."""
     rng = np.random.default_rng(seed)
     clock = GlobalClock()
     stats = ActorStats()
     store = ParamStore(8)
     store.publish(np.zeros(8, dtype=np.float32))
     log = ChunkLog()
+
+    # ---- mission-control plane (ISSUE 10): attached whenever the
+    # learner-stall drill or an explicit rule set asks for it
+    mission = None
+    learner_writer = None
+    if learner_stall > 0 or alert_rules is not None or log_dir:
+        from pytorch_distributed_tpu.config import (
+            AlertParams, MetricsParams,
+        )
+        from pytorch_distributed_tpu.utils import (
+            flight_recorder, telemetry,
+        )
+        from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+
+        if log_dir:
+            flight_recorder.configure(log_dir, run_id="chaos-soak")
+        mission = telemetry.MissionControl(
+            log_dir, MetricsParams(enabled=True, poll_s=0.2),
+            AlertParams(rules=alert_rules or SOAK_ALERT_RULES))
+        mission.start()
+        if log_dir:
+            # the full production ingest path: the simulated learner
+            # WRITES rows, the mission TAILS them (no direct feeding)
+            learner_writer = MetricsWriter(
+                log_dir, enable_tensorboard=False, role="learner",
+                run_id="chaos-soak")
+
+    def _health() -> dict:
+        return mission.status_block() if mission is not None else {}
+
     gw = DcnGateway(store, clock, stats, put_chunk=log,
-                    host="127.0.0.1", port=0, idle_deadline=30.0)
+                    host="127.0.0.1", port=port, idle_deadline=30.0,
+                    health=_health,
+                    metrics_sink=(mission.ingest_remote
+                                  if mission is not None else None))
     port = gw.port
     violations: List[str] = []
     fenced = 0
@@ -227,16 +285,40 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
         for i in range(actors)
     ]
 
-    deadline = time.monotonic() + seconds
+    t_start = time.monotonic()
+    deadline = t_start + seconds
     next_restart = (time.monotonic() + restart_every
                     if restart_every else float("inf"))
     incarnation_high: Dict[int, int] = {}
     learner_step = 0
+    stall_seen = False
     while time.monotonic() < deadline:
         time.sleep(0.1)
-        learner_step += 5  # the simulated learner's clock
-        clock.set_learner_step(learner_step)
-        if learner_step % 50 == 0:
+        elapsed = time.monotonic() - t_start
+        stalled = (learner_stall > 0
+                   and learner_stall_at <= elapsed
+                   < learner_stall_at + learner_stall)
+        if stalled:
+            # the injected learner stall (ISSUE 10 drill): the step
+            # clock freezes AND the stats cadence stops emitting — a
+            # stuck learner writes nothing, which is exactly what the
+            # absence rule watches for
+            stall_seen = True
+        else:
+            learner_step += 5  # the simulated learner's clock
+            clock.set_learner_step(learner_step)
+            if mission is not None:
+                row = {"tag": "learner/updates_per_s", "value": 50.0,
+                       "wall": time.time(), "step": learner_step,
+                       "role": "learner"}
+                if learner_writer is not None:
+                    learner_writer.scalar(row["tag"], row["value"],
+                                          step=learner_step,
+                                          wall=row["wall"])
+                    learner_writer.flush()
+                else:
+                    mission.metrics.ingest([row])
+        if learner_step and learner_step % 50 == 0 and not stalled:
             store.publish(np.full(8, learner_step, dtype=np.float32))
         # invariant: slots in range, incarnations never move backwards
         for slot, inc in gw.active_slots.items():
@@ -255,7 +337,10 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
             gateway_restarts += 1
             gw = DcnGateway(store, clock, stats, put_chunk=log,
                             host="127.0.0.1", port=port,
-                            idle_deadline=30.0)
+                            idle_deadline=30.0, health=_health,
+                            metrics_sink=(mission.ingest_remote
+                                          if mission is not None
+                                          else None))
             next_restart = (time.monotonic() + restart_every
                             * (0.5 + float(rng.random())))
 
@@ -273,6 +358,46 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
     fenced += gw.fenced
     quarantined += sum(gw.quarantined.values())
     gw.close()
+
+    # ---- alert-plane verdict (ISSUE 10): expected alerts must have
+    # fired AND resolved; anything else firing is a violation
+    alert_report: dict = {}
+    if mission is not None:
+        mission.stop()
+        snap = mission.engine.snapshot()
+        fired = sorted(a["rule"] for a in snap if a["fired_total"] > 0)
+        unresolved = sorted(a["rule"] for a in snap
+                            if a["state"] in ("pending", "firing"))
+        expected = ["learner_stall"] if stall_seen else []
+        unexpected = [r for r in fired if r not in expected]
+        if unexpected:
+            violations.append(
+                f"unexpected alert(s) fired: {unexpected}")
+        for r in expected:
+            if r not in fired:
+                violations.append(
+                    f"expected alert {r!r} never fired during the "
+                    f"learner-stall drill")
+        if unresolved:
+            violations.append(
+                f"alert(s) {unresolved} still unresolved after "
+                f"recovery")
+        alert_report = {
+            "rules": len(snap),
+            "fired": fired,
+            "unexpected": unexpected,
+            "unresolved": unresolved,
+            "resolved_total": sum(a["resolved_total"] for a in snap),
+            "stall_injected": bool(stall_seen),
+        }
+        if learner_writer is not None:
+            learner_writer.close()
+        if log_dir:
+            # leave the production post-mortem set: the mission's ring
+            # (alert transitions) + every other ring this process holds
+            from pytorch_distributed_tpu.utils import flight_recorder
+
+            flight_recorder.dump_all("chaos soak complete")
 
     seen = log.seen()
     acked = [t for a in fleet for t in a.acked_tags]
@@ -304,6 +429,8 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
         "gateway_restarts": gateway_restarts,
         "fenced": fenced,
         "final_learner_step": learner_step,
+        "alerts": alert_report,
+        "port": port,
     }
     if verbose:
         for k, v in report.items():
@@ -331,11 +458,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="every Nth chunk per actor ships NaN "
                          "reward/priority (0 disables); the gateway "
                          "quarantine must divert every one")
+    ap.add_argument("--learner-stall", type=float, default=0.0,
+                    metavar="SECS",
+                    help="freeze the simulated learner (clock + stats "
+                         "cadence) for SECS mid-run: the mission-"
+                         "control absence alert must fire during the "
+                         "stall and resolve after recovery (0 "
+                         "disables the alert drill)")
+    ap.add_argument("--learner-stall-at", type=float, default=3.0,
+                    metavar="SECS",
+                    help="seconds into the run the learner stall "
+                         "starts")
+    ap.add_argument("--log-dir", type=str, default=None,
+                    help="leave the production artifact set (blackbox "
+                         "rings with alert transitions, alert/* "
+                         "scalar rows) here for tools/timeline.py")
+    ap.add_argument("--port", type=int, default=0,
+                    help="gateway port (0 = ephemeral); pin it so a "
+                         "concurrent fleet_top can watch the soak")
     args = ap.parse_args(argv)
     report = soak(seconds=args.seconds, actors=args.actors, seed=args.seed,
                   restart_every=args.restart_every or None,
                   reconnect_timeout=args.reconnect_timeout,
-                  poison_every=args.poison_every)
+                  poison_every=args.poison_every,
+                  learner_stall=args.learner_stall,
+                  learner_stall_at=args.learner_stall_at,
+                  log_dir=args.log_dir, port=args.port)
     ok = not report["violations"]
     print(f"[chaos] {'OK' if ok else 'FAILED'} after {args.seconds:.0f}s: "
           f"{len(report['violations'])} violations")

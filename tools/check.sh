@@ -41,6 +41,15 @@ EOF
 fi
 echo "apexlint: PASS ($(python -c "import json,sys;d=json.load(open('$tmp/apexlint.json'));print(f\"{d['files']} files, {d['baselined']} baselined\")"))"
 
+echo "== stage 1b: fleet_top --selftest (mission-control alert plane) =="
+# the ISSUE-10 smoke: a synthetic gateway + mission control probed over
+# the real wire — T_METRICS push, absence alert fires, --json blocks
+# round-trip.  Seconds-scale, no jax.
+if ! JAX_PLATFORMS=cpu python tools/fleet_top.py --selftest; then
+    echo "fleet_top --selftest: FAIL"
+    exit 1
+fi
+
 if [ "${APEXLINT_ONLY:-0}" = "1" ]; then
     echo "APEXLINT_ONLY=1: skipping bench stages"
     exit 0

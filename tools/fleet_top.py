@@ -172,6 +172,65 @@ def data_line(status: dict,
     return "  data: " + " · ".join(bits) if bits else None
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def spark(values: List[float]) -> str:
+    """One unicode sparkline from a value list (min-max normalized; a
+    constant series renders mid-band so 'flat' and 'empty' differ)."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK[3] * len(values)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / (hi - lo) * (len(_SPARK) - 1)))]
+        for v in values)
+
+
+def alerts_line(status: dict) -> Optional[str]:
+    """One panel line for the mission-control alert engine (ISSUE 10):
+    the STATUS ``alerts`` block's per-rule states.  Firing/pending
+    rules are named with their detail; an all-clear shows the rule
+    count so 'no alerts configured' and 'all ok' stay distinct."""
+    alerts = status.get("alerts")
+    if alerts is None:
+        return None
+    loud = [a for a in alerts if a.get("state") in ("pending", "firing",
+                                                    "resolved")]
+    if not loud:
+        fired = sum(a.get("fired_total", 0) for a in alerts)
+        return (f"  alerts: ok ({len(alerts)} rule(s)"
+                + (f", {fired} fired lifetime" if fired else "") + ")")
+    bits = []
+    for a in sorted(loud, key=lambda a: a.get("state") != "firing"):
+        bits.append(f"{a['rule']} {a['state'].upper()} "
+                    f"{_fmt_age(a.get('age'))}"
+                    + (f" ({a['detail']})" if a.get("detail") else ""))
+    return "  alerts: " + " · ".join(bits)
+
+
+def series_lines(status: dict, max_rows: int = 5) -> List[str]:
+    """Sparkline trend rows from the STATUS ``series`` block — history
+    comes from the gateway-side aggregator's ring buffers, not from
+    this probe re-fetching and remembering values itself (a fresh
+    fleet_top shows the same trends a long-running one does)."""
+    series = status.get("series") or {}
+    out = []
+    for tag in sorted(series)[:max_rows]:
+        blk = series[tag] or {}
+        vals = [p[1] for p in blk.get("points") or []
+                if isinstance(p, (list, tuple)) and len(p) == 2]
+        if not vals:
+            continue
+        latest = blk.get("latest")
+        out.append(f"  ~ {tag:<28} {spark(vals):<32} "
+                   + (f"{latest:g}" if isinstance(latest, (int, float))
+                      else "-"))
+    return out
+
+
 def actor_line(status: dict) -> Optional[str]:
     """Per-actor slot line: env frames/s attributed to each LOCAL
     actor slot plus the schedule it actually runs (device / pipelined
@@ -228,6 +287,10 @@ def render(status: dict,
     aline = actor_line(status)
     if aline:
         lines.append(aline)
+    alline = alerts_line(status)
+    if alline:
+        lines.append(alline)
+    lines.extend(series_lines(status))
     # health sentinel (utils/health.py): guard skips / rollbacks / hang
     # kills from the learner host, quarantine counts split by boundary —
     # the gateway's per-slot counts name WHICH remote actor is poisoning
@@ -277,11 +340,72 @@ def _absorb_rows(latest: Dict[str, float], rows: List[dict]) -> None:
             latest[tag] = r["value"]
 
 
+def selftest() -> int:
+    """The pre-PR-gate smoke (tools/check.sh): a synthetic in-process
+    gateway + mission control, probed over the REAL wire path — a
+    T_METRICS push lands a series, the absence rule walks
+    pending→firing, and the ``--json`` blocks (``alerts``/``series``)
+    round-trip through ``fetch_status``.  No jax, seconds-scale."""
+    import time as _t
+
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.config import AlertParams, MetricsParams
+    from pytorch_distributed_tpu.parallel.dcn import (
+        DcnGateway, push_metrics,
+    )
+    from pytorch_distributed_tpu.utils import telemetry
+
+    mission = telemetry.MissionControl(
+        None, MetricsParams(enabled=True),
+        AlertParams(rules="stall: learner/updates_per_s absent 0.5s"))
+    gw = DcnGateway(ParamStore(4), GlobalClock(), ActorStats(),
+                    put_chunk=lambda items: None, host="127.0.0.1",
+                    port=0, health=lambda: mission.status_block(),
+                    metrics_sink=mission.ingest_remote)
+    try:
+        reply = push_metrics(
+            ("127.0.0.1", gw.port),
+            [{"tag": "learner/updates_per_s", "value": 42.0,
+              "wall": _t.time(), "step": 1, "role": "learner"}])
+        assert reply.get("accepted") == 1, f"push not absorbed: {reply}"
+        mission.poll()
+        status = fetch_status(("127.0.0.1", gw.port))
+        assert "alerts" in status and "series" in status, \
+            f"STATUS missing mission blocks: {sorted(status)}"
+        assert "learner/updates_per_s" in status["series"], \
+            f"pushed series missing: {status['series']}"
+        assert status["alerts"][0]["state"] == "ok", status["alerts"]
+        json.dumps(status)  # the --json path must stay serializable
+        assert alerts_line(status) and series_lines(status), \
+            "panel lines did not render"
+        _t.sleep(0.7)  # starve the series past the absence window
+        mission.poll()
+        status = fetch_status(("127.0.0.1", gw.port))
+        assert status["alerts"][0]["state"] == "firing", status["alerts"]
+        assert "FIRING" in (alerts_line(status) or ""), status["alerts"]
+    except AssertionError as e:
+        print(f"fleet_top --selftest: FAIL: {e}", file=sys.stderr)
+        return 1
+    finally:
+        gw.close()
+    print("fleet_top --selftest: PASS (push -> aggregate -> alert -> "
+          "--json blocks)", file=sys.stderr)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools/fleet_top.py",
         description="live fleet health over the DCN STATUS verb")
-    ap.add_argument("gateway", help="learner host gateway as host:port")
+    ap.add_argument("gateway", nargs="?", default=None,
+                    help="learner host gateway as host:port")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the in-process alert-plane smoke "
+                         "(synthetic gateway + mission control; the "
+                         "tools/check.sh stage) and exit 0/1")
     ap.add_argument("--json", action="store_true",
                     help="print one raw snapshot as JSON and exit "
                          "(nonzero if the gateway is unreachable)")
@@ -310,6 +434,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "server-side)")
     args = ap.parse_args(argv)
 
+    if args.selftest:
+        return selftest()
+    if args.gateway is None:
+        ap.error("gateway (host:port) required unless --selftest")
     host, _, port = args.gateway.rpartition(":")
     if not host or not port.isdigit():
         ap.error(f"--gateway must be host:port (got {args.gateway!r})")

@@ -59,6 +59,10 @@ from pytorch_distributed_tpu.utils.metrics import read_scalars  # noqa: E402
 _DEFAULT_SCALAR_PREFIXES = (
     "health/", "replay/priority", "learner/staleness",
     "learner/sample_age", "replay/actor_share", "perf/",
+    # ISSUE 10: alert-state step rows (0 ok, 1 pending, 2 firing) from
+    # the mission-control engine — the scalar-stream leg of an alert
+    # transition; the blackbox leg is the "alert" event kind below
+    "alert/",
 )
 
 # blackbox event kinds that mark the *incident* skeleton — rendered
@@ -66,7 +70,7 @@ _DEFAULT_SCALAR_PREFIXES = (
 _LOUD_KINDS = {
     "fault", "rollback", "anomaly", "dump", "dcn-terminal", "reconnect",
     "divergence-fatal", "quarantine", "hang-kill", "preemption",
-    "session-start", "prefetch-failed",
+    "session-start", "prefetch-failed", "alert",
 }
 
 
