@@ -1199,6 +1199,77 @@ def bench_metrics_overhead(windows: int = 6,
     return {"metrics_overhead": out}
 
 
+def bench_flow_overhead(chunks: int = 600, rows: int = 16,
+                        smoke: bool = False) -> dict:
+    """Flow-control plane cost on the ingest hot path (ISSUE 11
+    acceptance): a real DcnClient→DcnGateway wire ingest loop with the
+    plane at its production default (enabled, healthy — no credits on
+    the wire) measures the per-chunk ingest span, and the plane's
+    per-chunk adds — ``GatewayFlow.admit`` (time-gated governor
+    refresh + token-bucket meter) plus the ``grant`` read riding the
+    ack — are DIRECTLY timed in isolation.  The gate number
+    ``flow_overhead_frac`` is flow-work-per-chunk over ingest-span-
+    per-chunk, held under the 0.02 absolute band by bench_gate — the
+    PR-10 lesson applies verbatim: a difference of two noisy wire
+    throughputs on this loaded 2-vCPU host would read scheduler
+    hiccups as multi-% fake overhead, so the rate difference is never
+    the gate number.
+
+    ``smoke=True`` shrinks the loop to sub-second for CI; the
+    measurement logic is identical."""
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.parallel.dcn import DcnClient, DcnGateway
+    from pytorch_distributed_tpu.utils.experience import Transition
+
+    flow_iters = 20_000
+    if smoke:
+        chunks = min(chunks, 250)
+        flow_iters = 8_000
+    z = np.zeros(4, dtype=np.float32)
+    t = Transition(state0=z, action=np.int32(0), reward=np.float32(0.0),
+                   gamma_n=np.float32(0.99), state1=z,
+                   terminal1=np.float32(0.0))
+    chunk = [(t, 1.0)] * rows
+    store = ParamStore(4)
+    store.publish(np.zeros(4, dtype=np.float32))
+    gw = DcnGateway(store, GlobalClock(), ActorStats(),
+                    put_chunk=lambda items: None, host="127.0.0.1",
+                    port=0, pressure=lambda: 0.0)
+    assert gw.flow is not None, "flow plane off at its production default"
+    client = DcnClient(("127.0.0.1", gw.port), process_ind=0)
+    for _ in range(30):  # session + validator + allocator warmup
+        client.send_chunk(chunk)
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        client.send_chunk(chunk)
+    span = time.perf_counter() - t0
+    # the plane's per-chunk work, timed directly: the serve loop pays
+    # admit() per EXP frame and grant() inside every ack payload
+    t0 = time.perf_counter()
+    for _ in range(flow_iters):
+        gw.flow.admit(0, rows)
+        gw.flow.grant(0)
+    flow_s = time.perf_counter() - t0
+    client.close()
+    gw.close()
+    per_chunk = span / max(chunks, 1)
+    per_flow = flow_s / max(flow_iters, 1)
+    out = {
+        "chunks_per_sec_ingest": round(chunks / span, 1),
+        "chunk_ingest_us": round(per_chunk * 1e6, 2),
+        "flow_us_per_chunk": round(per_flow * 1e6, 3),
+        # the gate number: per-chunk flow work / per-chunk ingest span
+        "flow_overhead_frac": round(per_flow / per_chunk, 4),
+        "chunk_rows": rows,
+        "geometry": "smoke-wire" if smoke else "wire",
+    }
+    print(f"[bench_flow_overhead] {out}", file=sys.stderr, flush=True)
+    return {"flow_overhead": out}
+
+
 def bench_smoke(updates: int = 384) -> dict:
     """Seconds-scale, CPU-safe bench for CI gating (ISSUE 6 satellite):
     the dqn-mlp learner program fused over a small uniform HBM-style
@@ -1679,7 +1750,7 @@ def main() -> None:
     ap.add_argument("--mode", choices=("micro", "e2e", "both", "families",
                                        "sampler", "act", "actor",
                                        "health", "perf", "device_env",
-                                       "provenance", "metrics"),
+                                       "provenance", "metrics", "flow"),
                     default="both")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale CPU-safe bench (the dqn-mlp "
@@ -1721,6 +1792,9 @@ def main() -> None:
         # the pre-PR gate holds the <2% band continuously (additive
         # key — existing keys keep their meaning, so no schema bump)
         result.update(bench_metrics_overhead(smoke=True))
+        # ISSUE-11 flow-plane overhead rides the smoke output the same
+        # way (additive key, schema stays 4)
+        result.update(bench_flow_overhead(smoke=True))
         out = {
             "bench_schema": 4,
             "metric": "smoke_updates_per_sec",
@@ -1750,6 +1824,8 @@ def main() -> None:
         result.update(bench_provenance_overhead())
     if args.mode in ("both", "metrics"):
         result.update(bench_metrics_overhead())
+    if args.mode in ("both", "flow"):
+        result.update(bench_flow_overhead())
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))

@@ -144,6 +144,11 @@ class _ActorHarness:
             # the learner stopped draining must abort, not deadlock the
             # teardown join
             memory.set_stop(clock.stop)
+        if hasattr(memory, "configure_flow"):
+            # ISSUE-11 overload policy: shed-vs-block on the local
+            # spawn-queue feeder, selected from the run's FlowParams
+            # (env overrides land through flow.resolve_flow as usual)
+            memory.configure_flow(opt.flow_params)
 
         # data-plane provenance (ISSUE 8): every transition this actor
         # emits carries (actor_id, env_slot, param_version, birth_step)

@@ -104,6 +104,11 @@ KNOBS = (
      "TPU_APEX_METRICS_OPENMETRICS, TPU_APEX_METRICS_PUSH_S)"),
     ("TPU_APEX_ALERT_*", "utils/telemetry.py",
      "per-field AlertParams overrides (e.g. TPU_APEX_ALERT_RULES)"),
+    ("TPU_APEX_FLOW", "utils/flow.py",
+     "flow-control plane switch (shorthand for TPU_APEX_FLOW_ENABLED)"),
+    ("TPU_APEX_FLOW_*", "utils/flow.py",
+     "per-field FlowParams overrides (e.g. TPU_APEX_FLOW_LOCAL_POLICY, "
+     "TPU_APEX_FLOW_CLIENT_RING)"),
 )
 
 
@@ -536,6 +541,62 @@ class AlertParams:
 
 
 @dataclass
+class FlowParams:
+    """End-to-end flow-control / graceful-degradation knobs (ISSUE 11;
+    utils/flow.py — no reference equivalent: the reference blocks on a
+    full shared ring and has no overload story at all).  Every field is
+    env-overridable as ``TPU_APEX_FLOW_<FIELD>`` via
+    ``flow.resolve_flow`` (bare ``TPU_APEX_FLOW=0`` maps to
+    ``enabled``), the same spawn-inheritance contract the
+    health/perf/metrics planes use.
+
+    The plane is ON by default but INERT until the gateway's pressure
+    signal crosses ``throttle_at``: in the healthy state no credits
+    ride the wire, no chunk is ever shed, and the per-chunk cost is a
+    few dict/float ops (bench.py ``flow_overhead``)."""
+
+    # Master switch.  Off = the pre-ISSUE-11 behaviour everywhere: no
+    # credits, no admission control, blocking local feeders.
+    enabled: bool = True
+    # Client-side bounded buffer (CHUNKS) a creditless DcnClient parks
+    # experience in; overflow drops the OLDEST chunk (newest experience
+    # wins, Ape-X priority-on-arrival), counted + provenance-stamped.
+    client_ring: int = 256
+    # Local transports (spawn-queue feeder, device-replay ingest
+    # pending): "block" = the pre-ISSUE-11 backpressure stall (default);
+    # "shed" = bounded drop-oldest with counted drops, the same
+    # degradation contract the DCN client ring gives remote actors.
+    local_policy: str = "block"
+    # Feeder-side ring bound (CHUNKS) and device-ingest pending bound
+    # (ROWS) under local_policy="shed".
+    feeder_ring: int = 64
+    max_pending_rows: int = 65536
+    # Per-slot admission token bucket (CHUNKS/s + burst) metering the
+    # throttled state's credit grants — and, at brownout tier 3, the
+    # gateway-side shed of non-credit-aware peers.
+    bucket_rate: float = 200.0
+    bucket_burst: float = 400.0
+    # Credit grant cap per ack while throttled (the healthy state
+    # grants no credit field at all = unlimited; shedding grants 0).
+    credits_throttled: int = 4
+    # Overload state machine thresholds on the gateway pressure signal
+    # (0..1, e.g. ingest-queue utilization): sustained >= throttle_at
+    # escalates one state per ``dwell_s``; sustained < recover_at for
+    # ``recover_s`` de-escalates one state (hysteresis — the band
+    # between the two never flaps).
+    throttle_at: float = 0.75
+    shed_at: float = 0.92
+    recover_at: float = 0.50
+    dwell_s: float = 1.0
+    recover_s: float = 3.0
+    # Brownout ladder: seconds of SUSTAINED shedding before the tier
+    # climbs one rung (1 = shed telemetry pushes, 2 = + trace
+    # sampling, 3 = + oldest experience).  De-escalation rides the
+    # same ``recover_s`` hysteresis as the states.
+    brownout_dwell_s: float = 5.0
+
+
+@dataclass
 class ParallelParams:
     """TPU topology knobs — no reference equivalent (the reference is a
     single-node torch.multiprocessing program, SURVEY.md §2); this is where
@@ -611,6 +672,7 @@ class Options:
     perf_params: PerfParams = field(default_factory=PerfParams)
     metrics_params: MetricsParams = field(default_factory=MetricsParams)
     alert_params: AlertParams = field(default_factory=AlertParams)
+    flow_params: FlowParams = field(default_factory=FlowParams)
 
     @property
     def model_dir(self) -> str:
@@ -703,7 +765,8 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
         hits = []
         for sub in ("env_params", "memory_params", "model_params",
                     "agent_params", "parallel_params", "health_params",
-                    "perf_params", "metrics_params", "alert_params"):
+                    "perf_params", "metrics_params", "alert_params",
+                    "flow_params"):
             subobj = getattr(opt, sub)
             if hasattr(subobj, key):
                 hits.append((sub, subobj))

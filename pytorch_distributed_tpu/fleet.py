@@ -119,7 +119,30 @@ class FleetTopology(Topology):
             local_actors=self.local_actors,
             health=self._health_snapshot,
             profiler=self._profile_request,
-            metrics_sink=self._metrics_sink)
+            metrics_sink=self._metrics_sink,
+            flow_params=self.flow,
+            pressure=self._flow_pressure,
+            # overload transitions land in the run's scalar stream so
+            # the DEFAULT_RULES ``overload_shed`` alert (and the
+            # incident timeline) can see them; mission-off runs keep
+            # the flight-recorder leg only
+            flow_writer=(self.mission._writer
+                         if self.mission is not None else None))
+
+    def _flow_pressure(self) -> float:
+        """The overload governor's input signal: ingest-queue
+        utilization of the learner-side memory (the exact backlog a
+        slow learner grows), 0.0 when the queue is unreadable —
+        unknown pressure must read healthy, never shedding."""
+        ls = self.handles.learner_side
+        q = getattr(ls, "_q", None)
+        bound = int(getattr(ls, "max_queue_chunks", 0) or 0)
+        if q is None or bound <= 0 or not hasattr(q, "qsize"):
+            return 0.0
+        try:
+            return min(1.0, q.qsize() / bound)
+        except (NotImplementedError, OSError):
+            return 0.0  # macOS mp queues have no qsize
 
     def _metrics_sink(self, payload: dict) -> int:
         """T_METRICS provider: remote hosts' scalar batches land in the

@@ -402,11 +402,27 @@ class DeviceReplayIngest:
         self._pending: list = []
         self._fed_total = 0
         self._validator = None  # ingest quarantine, built on first drain
+        # ISSUE-11 shed policy (utils/flow.py): under
+        # ``local_policy="shed"`` the host-side pending list is bounded
+        # at ``max_pending_rows`` — oldest rows beyond it are dropped
+        # (counted + prov-stamped into flow_counters) instead of
+        # growing without bound when actors outrun the drain cadence.
+        # Default "block" keeps the pre-flow behaviour: the bounded mp
+        # queue is the backpressure point, pending stays unbounded.
+        self._flow_params = None  # resolved lazily on first drain
+        self.flow_counters: dict = {}
 
     def make_feeder(self, chunk: int = 16):
         from pytorch_distributed_tpu.memory.feeder import QueueFeeder
 
         return QueueFeeder(self._q, chunk)
+
+    def configure_flow(self, params=None) -> None:
+        """Pin the ISSUE-11 shed-vs-block policy for this ingest
+        (otherwise resolved from the environment on first drain)."""
+        from pytorch_distributed_tpu.utils import flow
+
+        self._flow_params = flow.resolve_flow(params)
 
     def attach(self, mesh: Optional[jax.sharding.Mesh] = None
                ) -> DeviceReplay:
@@ -477,7 +493,7 @@ class DeviceReplayIngest:
         every future minibatch samples from — and instead of crashing
         the learner's np.stack below on a shape drift."""
         from pytorch_distributed_tpu.memory.feeder import pop_chunks
-        from pytorch_distributed_tpu.utils import health, tracing
+        from pytorch_distributed_tpu.utils import flow, health, tracing
         from pytorch_distributed_tpu.utils.experience import (
             transition_dtypes,
         )
@@ -494,6 +510,17 @@ class DeviceReplayIngest:
                 health.get_quarantine("feeder-device").put(
                     bad, trace_id=tracing.current_trace())
         self._pending.extend(t for t, _priority in items)
+        if self._flow_params is None:
+            self._flow_params = flow.resolve_flow()
+        fp = self._flow_params
+        if (fp.enabled and fp.local_policy == "shed"
+                and len(self._pending) > fp.max_pending_rows):
+            # the device-ingest shed point (ISSUE 11): oldest pending
+            # rows beyond the bound are dropped, counted and
+            # prov-stamped — newest experience wins, memory stays
+            # bounded even when the drain cadence loses the race
+            self._pending = flow.shed_overflow(
+                self._pending, fp.max_pending_rows, self.flow_counters)
         fed = 0
         dt = transition_dtypes(self.replay.state_dtype,
                                self.replay.action_dtype)

@@ -46,6 +46,11 @@ class QueueFeeder:
         self._timeout_put = False
         self._tracer: Optional[tracing.Tracer] = None
         self._faults = None  # FEEDER_FAULTS injector, built lazily
+        # ISSUE-11 local shed policy (utils/flow.py): None until
+        # configure_flow — the default "block" path is byte-identical
+        # to the pre-flow feeder (backpressure stalls the producer)
+        self._flow_params = None
+        self._flow_ring = None
 
     def clone(self) -> "QueueFeeder":
         """Same queue, fresh chunk buffer — thread-backend workers each get
@@ -54,7 +59,35 @@ class QueueFeeder:
         f = QueueFeeder(self._q, self._chunk)
         if self._stop is not None:
             f.set_stop(self._stop)
+        if self._flow_params is not None:
+            f.configure_flow(self._flow_params)
         return f
+
+    def configure_flow(self, params=None) -> None:
+        """Select the overload policy for this feeder (ISSUE 11):
+        ``FlowParams.local_policy`` = "block" keeps the pre-flow
+        blocking put (default — correct when the queue bound IS the
+        intended backpressure), "shed" makes a full queue park chunks
+        in a bounded drop-oldest ring (newest experience wins; drops
+        counted + provenance-stamped) so a single-host topology
+        degrades exactly like the DCN client does.  The actor harness
+        calls this with the resolved ``opt.flow_params``; env overrides
+        (``TPU_APEX_FLOW_LOCAL_POLICY=shed``) reach spawn children
+        through ``flow.resolve_flow`` as usual."""
+        from pytorch_distributed_tpu.utils import flow
+
+        fp = flow.resolve_flow(params)
+        self._flow_params = fp
+        if (fp.enabled and fp.local_policy == "shed"
+                and hasattr(self._q, "put_nowait")):
+            if self._flow_ring is None:
+                self._flow_ring = flow.DropOldestRing(fp.feeder_ring)
+        else:
+            self._flow_ring = None
+
+    @property
+    def flow_dropped_rows(self) -> int:
+        return self._flow_ring.dropped_rows if self._flow_ring else 0
 
     def set_tracer(self, tracer) -> None:
         """Attach the owning role's span recorder (utils/tracing.py)."""
@@ -64,10 +97,14 @@ class QueueFeeder:
         # tracers and fault injectors hold threading locks: never ride a
         # spawn pickle — the child attaches its own role tracer after
         # unpickling and rebuilds the injector from FEEDER_FAULTS
-        # (spawn children inherit the env, utils/faults.py)
+        # (spawn children inherit the env, utils/faults.py).  The shed
+        # ring holds a lock too (and buffered chunks are this process's
+        # backlog, not the child's): the child re-engages its policy
+        # via configure_flow (the actor harness calls it with opt).
         d = self.__dict__.copy()
         d["_tracer"] = None
         d["_faults"] = None
+        d["_flow_ring"] = None
         return d
 
     def _injector(self):
@@ -123,11 +160,36 @@ class QueueFeeder:
             print("[faults:feeder] poison_chunk: chunk poisoned before "
                   "flush", flush=True)
         traced = tracing.active()  # TPU_APEX_TRACE=0: plain list, no
+        if traced:
+            from pytorch_distributed_tpu.utils import flow as _flow
+
+            # brownout tier >= 2 (ISSUE 11): the trace-sampling rung —
+            # new chunks ship untraced (counted) until the tier drops
+            if _flow.trace_shed():
+                _flow.note_shed("trace", 1)
+                traced = False
         chunk = (tracing.TracedChunk(self._buf)  # mint, no wire columns
                  if traced else self._buf)
         t0 = time.perf_counter()
         delivered = True
-        if self._stop is None or not self._timeout_put:
+        if self._flow_ring is not None:
+            # "shed" policy (ISSUE 11): never block the producer — a
+            # full queue parks the chunk in the bounded drop-oldest
+            # ring; later flushes (and this one) drain oldest-first as
+            # the queue frees up.  Drops are the ring's counted,
+            # provenance-stamped shed point.
+            self._flow_ring.put(chunk)
+            while True:
+                pending = self._flow_ring.pop()
+                if pending is None:
+                    break
+                try:
+                    self._q.put_nowait(pending)
+                except _queue.Full:
+                    self._flow_ring.unpop(pending)
+                    delivered = False
+                    break
+        elif self._stop is None or not self._timeout_put:
             self._q.put(chunk)
         else:
             while True:

@@ -53,11 +53,28 @@ tools/chaos_soak.py, policy knobs via ``DCN_*`` env vars):
   ``DCN_HEARTBEAT_INTERVAL`` of idleness and bounds every reply wait with
   ``DCN_REPLY_DEADLINE``; the gateway drops connections idle longer than
   ``DCN_IDLE_DEADLINE`` (> the ping interval), freeing their slots.  The
-  deadlines are deliberately long relative to ingest stalls: legitimate
-  backpressure (learner compile, full spawn queue) stalls the actor —
-  that is flow control — while a frozen or partitioned peer trips the
-  deadline and enters the reconnect path instead of hanging forever on
-  the old ``settimeout(None)`` socket.
+  deadlines are deliberately long relative to ingest stalls: a brief
+  stall (learner compile) rides under them, while a frozen or
+  partitioned peer trips the deadline and enters the reconnect path
+  instead of hanging forever on the old ``settimeout(None)`` socket.
+- **Overload degrades, never deadlocks (ISSUE 11, utils/flow.py).**
+  Sustained pressure (full spawn queue, slow learner ingest) no longer
+  stalls the fleet through blocking puts: the gateway's overload
+  governor (healthy → throttled → shedding, surfaced on T_STATUS and
+  alerted via DEFAULT_RULES) sizes per-slot send credits onto every
+  T_CLOCK ack; a creditless client parks chunks in a bounded
+  drop-oldest ring (newest experience wins, every drop counted +
+  provenance-stamped) while its T_PING heartbeats keep flowing — so a
+  throttled actor never reads as dead, is never reaped by the idle
+  deadline, and never blocks its own rollout loop.  Per-slot token
+  buckets meter the throttled grants (one runaway actor drains its own
+  bucket, not its neighbours'), and sustained shedding climbs a
+  brownout ladder — telemetry pushes first, then trace sampling, then
+  (tier 3, for credit-ignoring peers) oldest experience at the
+  gateway's one declared shed point.  Conservation is checkable live:
+  minted = ingested + dropped + quarantined (+ still-buffered), from
+  the counters on the STATUS ``flow`` block.  Drilled by
+  ``chaos_soak --flood`` / ``--slow-learner-ingest`` / ``--slow-slot``.
 - **"Learner said stop" and "connection lost" are distinct states**:
   ``DcnClient.stop`` is set only by a T_CLOCK reply carrying
   ``stop: true``; ``DcnClient.disconnected`` only by a terminal session
@@ -95,7 +112,7 @@ import numpy as np
 from pytorch_distributed_tpu.agents.param_store import ParamStore
 from pytorch_distributed_tpu.memory.feeder import QueueFeeder
 from pytorch_distributed_tpu.utils import experience, flight_recorder, \
-    tracing
+    flow, tracing
 from pytorch_distributed_tpu.utils.experience import Transition
 from pytorch_distributed_tpu.utils.faults import FaultInjector
 
@@ -299,7 +316,10 @@ class DcnGateway:
                  faults: Optional[FaultInjector] = None,
                  health: Optional[Callable[[], dict]] = None,
                  profiler: Optional[Callable[[dict], dict]] = None,
-                 metrics_sink: Optional[Callable[[dict], int]] = None):
+                 metrics_sink: Optional[Callable[[dict], int]] = None,
+                 flow_params=None,
+                 pressure: Optional[Callable[[], float]] = None,
+                 flow_writer=None):
         self.param_store = param_store
         self.clock = clock
         self.actor_stats = actor_stats
@@ -328,6 +348,16 @@ class DcnGateway:
         self.metrics_rows = 0
         self._tracer = tracing.get_tracer("gateway")
         self._recorder = flight_recorder.get_recorder("gateway")
+        # flow-control plane (ISSUE 11, utils/flow.py): per-slot credit
+        # grants on every ack, admission control + the brownout ladder.
+        # Inert without a ``pressure`` provider (the governor never
+        # leaves healthy, no credit field rides the wire), so bare
+        # test/tool gateways behave exactly as before.
+        self._flow = None
+        if flow.resolve_flow(flow_params).enabled:
+            self._flow = flow.GatewayFlow(
+                flow_params, pressure=pressure,
+                recorder=self._recorder, writer=flow_writer)
         self._born = time.monotonic()
         self._srv = socket.create_server((host, port))
         self._srv.settimeout(0.25)
@@ -376,8 +406,8 @@ class DcnGateway:
             self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
-    def _clock_payload(self) -> bytes:
-        return json.dumps({
+    def _clock_payload(self, slot: Optional[int] = None) -> bytes:
+        msg = {
             "learner_step": int(self.clock.learner_step.value),
             "stop": bool(self.clock.stop.is_set()),
             # gateway wall clock: remote clients estimate their offset
@@ -385,7 +415,26 @@ class DcnGateway:
             # so tools/timeline.py can align cross-host events on one
             # clock.  Old peers ignore the extra key.
             "wall": time.time(),
-        }).encode()
+        }
+        if self._flow is not None and slot is not None:
+            # flow control rides the ack (ISSUE 11): ``credits`` is how
+            # many chunks this slot may send before its next grant
+            # (absent while healthy = unlimited — old peers and calm
+            # fleets see the exact pre-flow wire); ``brownout`` tells
+            # the client host which shed tier the ladder is on.
+            grant = self._flow.grant(slot)
+            if grant is not None:
+                msg["credits"] = grant
+            tier = self._flow.governor.tier
+            if tier:
+                msg["brownout"] = tier
+        return json.dumps(msg).encode()
+
+    @property
+    def flow(self):
+        """The gateway's GatewayFlow plane (None when disabled) — read
+        by drills (tools/chaos_soak.py conservation verdict) and tests."""
+        return self._flow
 
     @property
     def active_slots(self) -> Dict[int, int]:
@@ -426,6 +475,12 @@ class DcnGateway:
             "frames_rejected": self.frames_rejected,
             "quarantined": dict(self.quarantined),
         }
+        if self._flow is not None:
+            # flow-control plane (ISSUE 11): overload state + brownout
+            # tier, per-slot credits/shed/drop-share and the
+            # conservation ledger — fleet_top's ``flow:`` panel line
+            snap["flow"] = self._flow.status_block(
+                quarantined=sum(snap["quarantined"].values()))
         if self._health is not None:
             try:
                 snap.update(self._health() or {})
@@ -615,6 +670,13 @@ class DcnGateway:
                                          f"metrics sink failed: {e!r}"}
                         self.metrics_batches += 1
                         reply["wall"] = time.time()
+                        if self._flow is not None \
+                                and self._flow.governor.tier >= 1:
+                            # brownout tier 1: the telemetry rung.  The
+                            # reply tells the pusher to shed ITS side
+                            # (counted there) so metrics traffic stops
+                            # competing with the experience plane.
+                            reply["brownout"] = self._flow.governor.tier
                         _send_frame(conn, T_METRICS,
                                     json.dumps(reply).encode())
                     elif ftype == T_EXP:
@@ -639,7 +701,7 @@ class DcnGateway:
                                       f"frame from slot {slot}: {e}",
                                       flush=True)
                             _send_frame(conn, T_CLOCK,
-                                        self._clock_payload())
+                                        self._clock_payload(slot))
                             continue
                         except Exception as e:
                             # byte-level corruption np.load itself chokes
@@ -648,12 +710,37 @@ class DcnGateway:
                             # failure model; never decode garbage)
                             raise ConnectionError(
                                 f"undecodable EXP frame: {e!r}")
-                        if isinstance(items, tracing.TracedChunk):
-                            # actor flush -> gateway receipt: the wire hop
+                        if isinstance(items, tracing.TracedChunk) \
+                                and not (self._flow is not None
+                                         and self._flow.governor.tier
+                                         >= 2):
+                            # actor flush -> gateway receipt: the wire
+                            # hop.  Suppressed at brownout tier >= 2
+                            # off the gateway's OWN governor (the
+                            # process-local flow.trace_shed latch is
+                            # only ever set by a DcnClient, which the
+                            # gateway process doesn't host) — covers
+                            # chunks from actors that haven't latched
+                            # the tier yet.
                             self._tracer.record_hop("gateway", items.born,
                                                     items.trace_id)
-                        items = self._quarantine(slot, items)
+                        admitted = (self._flow is None
+                                    or self._flow.admit(slot, len(items)))
+                        if admitted:
+                            items = self._quarantine(slot, items)
+                        else:
+                            # the gateway's ONE declared experience shed
+                            # point (brownout tier 3, bucket dry —
+                            # counted + recorded in GatewayFlow.admit):
+                            # ack so the peer doesn't retransmit the
+                            # very load being shed
+                            items = []
                         if items:
+                            if self._flow is not None:
+                                # ingested = admitted AND clean of the
+                                # quarantine: each row lands in exactly
+                                # one conservation bucket
+                                self._flow.note_ingested(len(items))
                             try:
                                 self.put_chunk(items)
                             except ValueError:
@@ -662,7 +749,7 @@ class DcnGateway:
                                 # clock instead of dying with a traceback
                                 pass
                         self.chunks_in += 1
-                        _send_frame(conn, T_CLOCK, self._clock_payload())
+                        _send_frame(conn, T_CLOCK, self._clock_payload(slot))
                     elif ftype == T_GETP:
                         try:
                             (min_version,) = struct.unpack("!Q", payload)
@@ -681,7 +768,11 @@ class DcnGateway:
                                 + np.ascontiguousarray(
                                     flat, dtype=np.float32).tobytes())
                     elif ftype == T_PING:
-                        _send_frame(conn, T_CLOCK, self._clock_payload())
+                        # the ack carries the slot's fresh credit grant:
+                        # heartbeats are how a credit-blocked client
+                        # learns it may drain its ring again (throttled
+                        # never reads as dead OR stays blocked forever)
+                        _send_frame(conn, T_CLOCK, self._clock_payload(slot))
                     elif ftype == T_TICK:
                         msg = self._json(payload)
                         try:
@@ -698,7 +789,14 @@ class DcnGateway:
                                 self.clock.add_actor_steps(steps)
                             if kv:
                                 self.actor_stats.add(**kv)
-                        _send_frame(conn, T_CLOCK, self._clock_payload())
+                        if self._flow is not None:
+                            # cumulative client flow counters (minted/
+                            # dropped/buffered) — idempotent outside the
+                            # dedup gate, so a retransmitted tick can
+                            # never double-count drops
+                            self._flow.on_client_report(
+                                slot, msg.get("flow"))
+                        _send_frame(conn, T_CLOCK, self._clock_payload(slot))
                     elif ftype == T_HELLO:
                         msg = self._json(payload)
                         try:
@@ -716,7 +814,7 @@ class DcnGateway:
                                         json.dumps(reply).encode())
                             return
                         slot = ind
-                        _send_frame(conn, T_CLOCK, self._clock_payload())
+                        _send_frame(conn, T_CLOCK, self._clock_payload(slot))
                     else:
                         raise ConnectionError(f"bad frame type {ftype}")
         except (ConnectionError, OSError):
@@ -950,6 +1048,21 @@ class DcnClient:
         # process's fresh counter still lands above its predecessor's
         self._tick_seq = time.time_ns() // 1_000_000
         self.reconnects = 0
+        # ---- flow control (ISSUE 11, utils/flow.py): ``credits`` is
+        # the gateway's latest per-ack grant — None means the gateway
+        # sent no credit field (healthy state, or a pre-flow gateway):
+        # unlimited, the exact pre-ISSUE-11 behaviour.  At grant 0 the
+        # client parks chunks in a bounded drop-oldest ring instead of
+        # blocking the actor (newest experience wins; drops counted +
+        # provenance-stamped) and keeps heartbeating, so throttled
+        # never reads as dead and never deadlocks.
+        self._flow_params = flow.resolve_flow()
+        self.credits: Optional[int] = None
+        self.flow_ring = flow.DropOldestRing(
+            self._flow_params.client_ring, owner=process_ind)
+        self.flow_minted_rows = 0   # rows offered to send_chunk
+        self.flow_acked_rows = 0    # rows the wire acknowledged
+        self._flow_blocked_logged = False
         # estimated wall-clock offset to the gateway host (seconds to ADD
         # to local time.time() to land on the gateway's clock), derived
         # NTP-style from T_CLOCK replies' ``wall`` against the RPC
@@ -1032,6 +1145,17 @@ class DcnClient:
                     "clock_sync", offset=round(self.clock_offset, 6),
                     slot=self.process_ind)
         self.learner_step = int(msg["learner_step"])
+        if self._flow_params.enabled:
+            # absent credit field = healthy/legacy gateway = unlimited
+            c = msg.get("credits")
+            self.credits = int(c) if c is not None else None
+            tier = int(msg.get("brownout", 0) or 0)
+            if tier != flow.brownout_tier():
+                # latch the ladder tier for this process's shed hooks
+                # (RemoteStats / QueueFeeder trace minting)
+                flow.set_brownout(tier)
+                self._recorder.record("brownout", tier=tier,
+                                      slot=self.process_ind)
         if msg.get("stop"):
             self.stop.set()
         if "error" in msg:  # e.g. actor-slot conflict at HELLO
@@ -1188,8 +1312,56 @@ class DcnClient:
 
     # -- RPC surface --------------------------------------------------------
 
-    def send_chunk(self, items: list) -> None:
+    def _flow_blocked(self) -> bool:
+        return (self._flow_params.enabled and self.credits is not None
+                and self.credits <= 0)
+
+    def _send_exp(self, items: list) -> None:
+        """One credit-consuming EXP round-trip (the reply re-grants)."""
+        if self.credits is not None:
+            self.credits -= 1
         self._request(T_EXP, encode_chunk(items))
+        self.flow_acked_rows += len(items)
+
+    def send_chunk(self, items: list) -> None:
+        """Ship one chunk, credit-aware (ISSUE 11).  With send credit
+        (or a gateway that grants none — healthy/legacy) this is the
+        usual synchronous RPC, draining any ring backlog first so
+        experience stays ordered.  At grant 0 the chunk parks in the
+        bounded drop-oldest ring and the call RETURNS — the actor keeps
+        ticking (its heartbeats keep the session claimed and fetch the
+        next grant), the ring's oldest rows are the counted,
+        provenance-stamped cost of sustained overload."""
+        self.flow_minted_rows += len(items)
+        with self._lock:
+            if self._flow_blocked():
+                if self.flow_ring.put(items) and not self._flow_blocked_logged:
+                    self._flow_blocked_logged = True
+                    print(f"[dcn] slot {self.process_ind}: credit-blocked "
+                          f"ring full — shedding oldest experience "
+                          f"(counted; newest wins)", flush=True)
+                return
+            # drain the backlog first (oldest buffered chunk precedes
+            # this one on the wire); every reply refreshes the grant,
+            # so a re-throttle mid-drain parks the rest again
+            while len(self.flow_ring):
+                buffered = self.flow_ring.pop()
+                if buffered is None:
+                    break
+                self._send_exp(buffered)
+                if self._flow_blocked():
+                    self.flow_ring.put(items)
+                    return
+            self._send_exp(items)
+
+    def flow_report(self) -> Dict[str, int]:
+        """Cumulative flow counters for the T_TICK report (idempotent
+        by construction — the gateway's conservation ledger reads
+        them)."""
+        return {"minted": self.flow_minted_rows,
+                "acked": self.flow_acked_rows,
+                "dropped": self.flow_ring.dropped_rows,
+                "buffered": self.flow_ring.buffered_rows}
 
     def get_params(self, min_version: int
                    ) -> Optional[Tuple[np.ndarray, int]]:
@@ -1204,6 +1376,11 @@ class DcnClient:
         msg: Dict[str, Any] = {"actor_steps": actor_steps}
         if stats:
             msg["stats"] = stats
+        if self._flow_params.enabled and self.flow_minted_rows:
+            # cumulative (not delta) flow counters: a retransmitted
+            # tick re-ships the same totals, so the gateway-side
+            # conservation ledger is dedup-proof by construction
+            msg["flow"] = self.flow_report()
         with self._lock:
             # seq assigned under the request lock so ticks hit the wire
             # in seq order; a retransmit reuses the SAME payload bytes,
@@ -1214,6 +1391,19 @@ class DcnClient:
         return self.learner_step
 
     def close(self) -> None:
+        try:
+            # best-effort final drain of the credit-blocked backlog:
+            # whatever the grant allows ships, the rest stays counted
+            # in the ring (``buffered`` in the last flow report)
+            if len(self.flow_ring) and not self.disconnected.is_set():
+                with self._lock:
+                    while not self._flow_blocked():
+                        buffered = self.flow_ring.pop()
+                        if buffered is None:
+                            break
+                        self._send_exp(buffered)
+        except (ConnectionError, OSError):
+            pass
         self._closed = True
         if self._hb_thread is not None:
             self._hb_stop.set()
@@ -1336,12 +1526,18 @@ class RemoteStats:
     """ActorStats.add surface: forwards accumulator increments inline —
     actors already batch their stats on the ``actor_freq`` cadence
     (agents/actor.py flush_stats), so one RPC per flush is the right
-    granularity."""
+    granularity.  At brownout tier >= 1 (the telemetry rung of the
+    ISSUE-11 ladder, latched off gateway replies) stat pushes are shed
+    — counted via ``flow.note_shed`` — so reporting traffic yields to
+    the experience plane first."""
 
     def __init__(self, client: DcnClient):
         self._client = client
 
     def add(self, **kv: float) -> None:
+        if flow.telemetry_shed():
+            flow.note_shed("stats", 1)
+            return
         try:
             self._client.tick(stats={k: float(v) for k, v in kv.items()})
         except (ConnectionError, OSError):

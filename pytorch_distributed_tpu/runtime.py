@@ -135,10 +135,16 @@ class Topology:
         # publishes liveness-progress marks on a shared board riding the
         # clock's spawn pickle; the monitor SIGKILLs workers whose marks
         # go stale past hang_deadline (utils/supervision.ProgressBoard).
-        from pytorch_distributed_tpu.utils import health, perf
+        from pytorch_distributed_tpu.utils import flow, health, perf
         from pytorch_distributed_tpu.utils.supervision import ProgressBoard
 
         self.health = health.resolve(opt.health_params)
+        # flow-control plane (ISSUE 11): resolved once and exported to
+        # the environment so spawn children (actor feeders building
+        # their shed rings, the device-ingest pending bound) resolve
+        # the same policy the topology was configured with
+        self.flow = flow.resolve_flow(opt.flow_params)
+        flow.export_env(self.flow)
         # perf plane knobs resolved once for the topology; exported to
         # the environment so spawn children (and tools THEY fork)
         # resolve the same plane even when it was enabled

@@ -56,11 +56,14 @@ Injectors are wired through env vars so fault schedules reach spawn
 children without plumbing: ``DCN_FAULTS_CLIENT`` / ``DCN_FAULTS_GATEWAY``
 (wire roles) and ``{ROLE}_FAULTS`` for the other planes — ``CKPT_FAULTS``
 (checkpoint writer), ``FEEDER_FAULTS`` (actor-side chunk flushes),
-``LEARNER_FAULTS`` (update steps), ``ACTOR_FAULTS`` (vector ticks) —
-hold either a scripted spec or ``random:SEED`` (see
-``FaultInjector.from_env``); fleet.py exposes the DCN pair as
-``--faults-client`` / ``--faults-gateway`` CLI knobs.  No spec = a null
-injector whose per-frame cost is one lock + dict probe.
+``LEARNER_FAULTS`` (update steps), ``ACTOR_FAULTS`` (vector ticks),
+``INGEST_FAULTS`` (the learner-side ingest drain, one frame per drained
+chunk — ``delay@N:S`` there is the slow-learner-ingest overload lever
+the ISSUE-11 flow-control drills pull, tools/chaos_soak.py
+``--slow-learner-ingest``) — hold either a scripted spec or
+``random:SEED`` (see ``FaultInjector.from_env``); fleet.py exposes the
+DCN pair as ``--faults-client`` / ``--faults-gateway`` CLI knobs.  No
+spec = a null injector whose per-frame cost is one lock + dict probe.
 """
 
 from __future__ import annotations

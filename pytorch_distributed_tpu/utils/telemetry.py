@@ -420,12 +420,17 @@ def parse_rules(specs) -> List[AlertRule]:
 
 
 # The rule set a bare ``TPU_APEX_METRICS=1`` fleet runs (AlertParams.
-# rules = ""): the three series the ROADMAP's scale-out items are
-# operated by.  Sized for production cadences — drills override.
+# rules = ""): the series the ROADMAP's scale-out items are operated
+# by.  Sized for production cadences — drills override.
+# ``overload_shed`` watches the ISSUE-11 flow plane: sustained
+# shedding (state code 2 on ``flow/overload_state``, written by the
+# overload governor on its transitions) pages — throttling is normal
+# degradation, minutes of shedding means the fleet is sized wrong.
 DEFAULT_RULES = (
     "learner_stall: learner/updates_per_s absent 120s",
     "staleness_burn: data/staleness_p50 > 100 frac 0.5 over 300s",
     "priority_collapse: replay/priority_ess_frac < 0.02 for 120s",
+    "overload_shed: flow/overload_state >= 2 for 120s",
 )
 
 
@@ -744,6 +749,13 @@ class MetricsPusher:
         self.pushed_rows = 0
         self.push_errors = 0
         self.dropped_rows = 0
+        # ISSUE-11 brownout tier 1 (the telemetry rung): the gateway's
+        # T_METRICS reply carries ``brownout`` while the ladder is
+        # engaged; this pusher then sheds its pending rows (counted
+        # here) until a reply clears it — metrics traffic yields to
+        # the experience plane first, and never silently.
+        self.brownout = 0
+        self.brownout_shed_rows = 0
         self._warned_drop = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -761,6 +773,10 @@ class MetricsPusher:
             sample = float(gw_wall) - mid
             self.offset = (sample if self.offset is None
                            else 0.9 * self.offset + 0.1 * sample)
+        try:
+            self.brownout = int(reply.get("brownout", 0) or 0)
+        except (TypeError, ValueError):
+            self.brownout = 0
         return reply
 
     def push_once(self) -> int:
@@ -771,6 +787,17 @@ class MetricsPusher:
         counted."""
         self._pending.extend(r for r in self._tail.poll()
                              if is_scalar_row(r))
+        if self.brownout >= 1 and self._pending:
+            # the telemetry rung of the brownout ladder: shed this
+            # cadence's rows (counted), then ping with an empty batch
+            # so recovery — a reply without ``brownout`` — is observed
+            self.brownout_shed_rows += len(self._pending)
+            self._pending = []
+            try:
+                self._rpc([])
+            except (ConnectionError, OSError):
+                self.push_errors += 1
+            return 0
         if len(self._pending) > self.MAX_PENDING:
             shed = len(self._pending) - self.MAX_PENDING
             del self._pending[:shed]
@@ -851,7 +878,7 @@ class MissionControl:
     # so a fleet without the perf plane still gets trend lines.
     KEY_TAGS = ("learner/updates_per_s", "learner/mfu",
                 "actor/env_frames_per_s", "data/staleness_p50",
-                "replay/priority_ess_frac",
+                "replay/priority_ess_frac", "flow/overload_state",
                 "learner/critic_loss", "evaluator/avg_reward",
                 "actor/avg_reward", "learner/steps_per_sec")
 

@@ -68,6 +68,7 @@ SPECS: List[Tuple[str, str, str]] = [
     ("provenance_overhead.provenance_overhead_frac", "lower_abs",
      "overhead"),
     ("metrics_overhead.metrics_overhead_frac", "lower_abs", "overhead"),
+    ("flow_overhead.flow_overhead_frac", "lower_abs", "overhead"),
     ("device_env.host_frames_per_sec", "higher", "device_env"),
     ("device_env.device_frames_per_sec", "higher", "device_env"),
     ("device_env.fused_frames_per_sec", "higher", "device_env"),

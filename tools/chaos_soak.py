@@ -35,11 +35,39 @@ wire.  Exits nonzero on any invariant violation:
   alert transitions, ``alert/*`` scalar rows) so ``tools/timeline.py``
   reconstructs the incident.
 
+Overload drills (ISSUE 11, the flow-control plane — utils/flow.py):
+``--flood`` (every actor pushes flat-out at a slow simulated learner
+ingest), ``--slow-learner-ingest SECS`` (the drain freezes mid-run),
+and ``--slow-slot`` (one runaway actor floods while its neighbours
+pace normally — the fairness drill).  Each runs the PRODUCTION credit
+path: the gateway's overload governor reads live backlog pressure,
+grants per-slot credits on acks, clients park experience in their
+bounded drop-oldest rings, and the ``overload`` alert must fire during
+the event and resolve after it.  Violations on top of the session-layer
+set:
+
+- **deadlock** — any actor thread still alive at the join deadline
+  (the exact fleet-freeze the credit plane exists to prevent);
+- **unbounded memory** — the ingest backlog or any client ring
+  exceeding its declared bound;
+- **uncounted drops / conservation breached** — the ledger
+  ``minted = delivered + dropped(client) + shed(gateway) + quarantined
+  + buffered`` must balance EXACTLY (every drop happens at a declared,
+  counted shed point; the drills run without wire faults so
+  at-least-once retransmits cannot blur the count);
+- **overload never engaged** — a flood that never moves the governor
+  proves nothing;
+- **fairness breached** (``--slow-slot``) — a well-paced actor starved
+  (acked below 70% of minted) by its runaway neighbour.
+
 Usage:
     python tools/chaos_soak.py --seconds 30 --actors 4 --seed 0
     python tools/chaos_soak.py --seconds 60 --restart-every 5
     python tools/chaos_soak.py --seconds 10 --learner-stall 2.5 \
         --learner-stall-at 3 --log-dir logs/soak
+    python tools/chaos_soak.py --seconds 12 --flood
+    python tools/chaos_soak.py --seconds 12 --slow-learner-ingest 3
+    python tools/chaos_soak.py --seconds 12 --slow-slot
 
 The same ``SyntheticActor`` drives the deterministic chaos scenarios in
 tests/test_chaos.py; this entry point is the long-haul randomized
@@ -105,6 +133,73 @@ class ChunkLog:
             return out
 
 
+class IngestSim:
+    """Simulated learner-side ingest: a bounded-pressure backlog plus a
+    paced drain thread — the spawn queue + learner drain cadence
+    without jax.  The gateway's ``put_chunk`` appends; the drain pops
+    oldest-first at ``rate`` chunks/s into the real sink (ChunkLog),
+    consulting the ``INGEST_FAULTS`` injector once per drained chunk
+    (``delay@N:S`` is the scripted slow-ingest lever).  ``pressure()``
+    — backlog depth over ``bound`` — is the overload governor's input;
+    ``pause()`` is the ``--slow-learner-ingest`` lever."""
+
+    def __init__(self, sink, bound: int = 64, rate: float = 400.0):
+        self._sink = sink
+        self.bound = bound
+        self.rate = rate
+        self._lock = threading.Lock()
+        self._backlog: List[list] = []
+        self.backlog_high = 0
+        self.drained_chunks = 0
+        self._pause_until = 0.0
+        self._faults = FaultInjector.from_env("ingest")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._drain_loop,
+                                        name="ingest-sim", daemon=True)
+        self._thread.start()
+
+    def __call__(self, items: list) -> None:
+        with self._lock:
+            self._backlog.append(items)
+            self.backlog_high = max(self.backlog_high, len(self._backlog))
+
+    def pressure(self) -> float:
+        with self._lock:
+            return min(1.0, len(self._backlog) / self.bound)
+
+    def pause(self, seconds: float) -> None:
+        self._pause_until = max(self._pause_until,
+                                time.monotonic() + seconds)
+
+    def _drain_loop(self) -> None:
+        period = 1.0 / max(self.rate, 1.0)
+        while not self._stop.is_set():
+            if time.monotonic() < self._pause_until:
+                time.sleep(0.02)
+                continue
+            with self._lock:
+                items = self._backlog.pop(0) if self._backlog else None
+            if items is None:
+                time.sleep(0.005)
+                continue
+            self._faults.frame(b"")
+            self._sink(items)
+            self.drained_chunks += 1
+            time.sleep(period)
+
+    def close(self) -> None:
+        """Stop pacing and hand the remaining backlog to the sink — at
+        shutdown every gateway-admitted row must reach the delivery log
+        or the conservation verdict would blame the simulator."""
+        self._stop.set()
+        self._thread.join(2.0)
+        with self._lock:
+            backlog, self._backlog = self._backlog, []
+        for items in backlog:
+            self._sink(items)
+            self.drained_chunks += 1
+
+
 class SyntheticActor:
     """Drives every client surface of the session layer — experience
     chunks, clock ticks, stat pushes, param fetches — without envs, jax,
@@ -115,11 +210,17 @@ class SyntheticActor:
     def __init__(self, address, slot: int, steps: int = 10 ** 9,
                  client_kwargs: Optional[dict] = None, pace: float = 0.0,
                  poison_every: int = 0, stall_at: int = -1,
-                 stall_s: float = 0.0):
+                 stall_s: float = 0.0,
+                 calm_at: float = -1.0, calm_pace: float = 0.05):
         self.address = address
         self.slot = slot
         self.steps = steps
         self.pace = pace
+        # overload drills: flood until ``calm_at`` seconds in, then drop
+        # to ``calm_pace`` — the recovery phase the governor (and the
+        # ``overload`` alert's resolve leg) must be observed through
+        self.calm_at = calm_at
+        self.calm_pace = calm_pace
         self.poison_every = poison_every  # every Nth chunk ships NaN
         self.stall_at = stall_at          # chunk index of a long freeze
         self.stall_s = stall_s
@@ -149,6 +250,7 @@ class SyntheticActor:
         rparams = RemoteParamStore(client)
         i = 0
         last_step = -1
+        t0 = time.monotonic()
         try:
             while not rclock.done(self.steps):
                 if i == self.stall_at and self.stall_s > 0:
@@ -178,8 +280,11 @@ class SyntheticActor:
                     self.step_regressions += 1
                 last_step = step
                 i += 1
-                if self.pace:
-                    time.sleep(self.pace)
+                pace = self.pace
+                if 0 <= self.calm_at <= time.monotonic() - t0:
+                    pace = self.calm_pace
+                if pace:
+                    time.sleep(pace)
         except (ConnectionError, OSError):
             pass  # terminal loss: outcome read from the latched events
         except Exception as e:
@@ -202,6 +307,31 @@ class SyntheticActor:
 SOAK_ALERT_RULES = ("learner_stall: learner/updates_per_s absent 1.5s; "
                     "learner_slow: learner/updates_per_s < 1 for 2s")
 
+# the overload drills' rule set (ISSUE 11): the flow rule the drill
+# MUST fire (>= 0.5 catches throttled=1 and shedding=2) and resolve,
+# plus the quiet-by-construction learner rule for the unexpected-alert
+# invariant (the simulated learner keeps emitting 50 up/s throughout)
+FLOW_ALERT_RULES = ("overload: flow/overload_state >= 0.5 for 0.3s; "
+                    "learner_slow: learner/updates_per_s < 1 for 2s")
+
+
+class _AggregatorWriter:
+    """MetricsWriter-shaped shim feeding the overload governor's
+    ``flow/*`` rows straight into the aggregator when the soak runs
+    without a log dir (with one, the governor gets a real writer and
+    the mission TAILS it — the production path)."""
+
+    def __init__(self, metrics):
+        self._metrics = metrics
+
+    def scalar(self, tag, value, step=0, wall=None):
+        self._metrics.ingest([{"tag": tag, "value": float(value),
+                               "wall": wall or time.time(),
+                               "step": int(step), "role": "gateway"}])
+
+    def flush(self):
+        pass
+
 
 def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
          restart_every: Optional[float] = 5.0,
@@ -209,13 +339,18 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
          reconnect_timeout: float = 10.0,
          poison_every: int = 40,
          learner_stall: float = 0.0, learner_stall_at: float = 3.0,
+         flood: bool = False, slow_ingest: float = 0.0,
+         slow_ingest_at: float = 3.0, slow_slot: bool = False,
          log_dir: Optional[str] = None, port: int = 0,
          alert_rules: Optional[str] = None,
          verbose: bool = True) -> dict:
     """Run the randomized soak; returns a report dict whose
     ``violations`` list is empty on a healthy session layer (and, with
-    ``learner_stall`` > 0, a healthy alert plane — see module
-    docstring)."""
+    ``learner_stall`` > 0 or an overload drill flag, a healthy
+    alert/flow plane — see module docstring)."""
+    from pytorch_distributed_tpu.config import FlowParams
+    from pytorch_distributed_tpu.utils import flow as flow_mod
+
     rng = np.random.default_rng(seed)
     clock = GlobalClock()
     stats = ActorStats()
@@ -223,11 +358,38 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
     store.publish(np.zeros(8, dtype=np.float32))
     log = ChunkLog()
 
+    # ---- overload drills (ISSUE 11): deterministic conservation needs
+    # a wire with no injected faults (retransmit duplicates would blur
+    # the exactly-once count) and one long-lived governor (no gateway
+    # restarts); the flood keeps a quarantine leg only where the drill
+    # isn't shedding most of the poison client-side anyway
+    flow_drill = bool(flood or slow_ingest > 0 or slow_slot)
+    drill_env_saved: Dict[str, Optional[str]] = {}
+    if flow_drill:
+        restart_every = None
+        fault_rates = {}
+        learner_stall = 0.0
+        if flood or slow_slot:
+            poison_every = 0
+        # clients resolve their OWN FlowParams from the environment
+        # (the production spawn-inheritance contract) — size their ring
+        # for a seconds-scale drill: at the default 256 chunks, three
+        # recovering clients dump ~768 buffered chunks into a 48-bound
+        # ingest and re-flood it forever (bufferbloat oscillation — the
+        # drill would never observe the alert resolve)
+        for k, v in (("TPU_APEX_FLOW_CLIENT_RING", "24"),):
+            drill_env_saved[k] = os.environ.get(k)
+            os.environ[k] = v
+    flow_mod.reset_shed_state()
+
     # ---- mission-control plane (ISSUE 10): attached whenever the
-    # learner-stall drill or an explicit rule set asks for it
+    # learner-stall drill, an overload drill, or an explicit rule set
+    # asks for it
     mission = None
     learner_writer = None
-    if learner_stall > 0 or alert_rules is not None or log_dir:
+    flow_writer = None
+    if learner_stall > 0 or flow_drill or alert_rules is not None \
+            or log_dir:
         from pytorch_distributed_tpu.config import (
             AlertParams, MetricsParams,
         )
@@ -240,23 +402,60 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
             flight_recorder.configure(log_dir, run_id="chaos-soak")
         mission = telemetry.MissionControl(
             log_dir, MetricsParams(enabled=True, poll_s=0.2),
-            AlertParams(rules=alert_rules or SOAK_ALERT_RULES))
+            AlertParams(rules=alert_rules
+                        or (FLOW_ALERT_RULES if flow_drill
+                            else SOAK_ALERT_RULES)))
         mission.start()
         if log_dir:
             # the full production ingest path: the simulated learner
-            # WRITES rows, the mission TAILS them (no direct feeding)
+            # (and the overload governor) WRITE rows, the mission TAILS
+            # them (no direct feeding)
             learner_writer = MetricsWriter(
                 log_dir, enable_tensorboard=False, role="learner",
                 run_id="chaos-soak")
+            flow_writer = MetricsWriter(
+                log_dir, enable_tensorboard=False, role="gateway",
+                run_id="chaos-soak")
+        elif mission is not None:
+            flow_writer = _AggregatorWriter(mission.metrics)
 
     def _health() -> dict:
         return mission.status_block() if mission is not None else {}
 
-    gw = DcnGateway(store, clock, stats, put_chunk=log,
+    # ---- the ingest + flow plane for overload drills: a paced drain
+    # behind the gateway, its backlog pressure driving the governor.
+    # Non-drill soaks keep the direct sink and an inert flow plane
+    # (healthy forever — no pressure provider), exactly as before.
+    ingest: Optional[IngestSim] = None
+    flow_params = None
+    pressure = None
+    if flow_drill:
+        # flood: a drain the fleet trivially outruns; slow-slot: one
+        # the RUNAWAY alone swamps but calm peers don't; slow-ingest: a
+        # comfortable drain, so overload comes only from the pause
+        ingest = IngestSim(log, bound=48,
+                           rate=(120.0 if flood else
+                                 160.0 if slow_slot else 400.0))
+        pressure = ingest.pressure
+        flow_params = FlowParams(
+            dwell_s=0.2, recover_s=0.4, brownout_dwell_s=1.0,
+            throttle_at=0.6, shed_at=0.9, recover_at=0.3,
+            client_ring=24,
+            # slow-slot: per-slot buckets sized so a well-paced actor
+            # (~50 chunks/s) never drains its bucket while the runaway
+            # does — the fairness mechanism under test
+            bucket_rate=80.0, bucket_burst=40.0)
+
+    gw = DcnGateway(store, clock, stats,
+                    put_chunk=(ingest if ingest is not None else log),
                     host="127.0.0.1", port=port, idle_deadline=30.0,
                     health=_health,
                     metrics_sink=(mission.ingest_remote
-                                  if mission is not None else None))
+                                  if mission is not None else None),
+                    flow_params=flow_params, pressure=pressure,
+                    flow_writer=flow_writer)
+    if gw.flow is not None and flow_drill:
+        gw.flow._update_every = 0.1  # seconds-scale drill cadence
     port = gw.port
     violations: List[str] = []
     fenced = 0
@@ -266,18 +465,41 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
     # one seeded actor gets a mid-run freeze of several heartbeat
     # intervals — the hang-adjacent stall the session layer must ride
     # through (the full hang->SIGKILL->respawn ladder needs a process
-    # supervisor and is drilled by tests/test_health.py)
-    stall_slot = int(rng.integers(actors)) if actors else -1
+    # supervisor and is drilled by tests/test_health.py).  Overload
+    # drills skip it (their timing story is the credit plane's).
+    stall_slot = (int(rng.integers(actors))
+                  if actors and not flow_drill else -1)
+
+    def _pace(i: int) -> float:
+        if flood:
+            return 0.0005       # everyone floods
+        if slow_slot:
+            return 0.0005 if i == 0 else 0.04  # one runaway, calm peers
+        if slow_ingest > 0:
+            return 0.01         # healthy until the drain pauses
+        return 0.002
+
+    def _calm_at(i: int) -> float:
+        """Seconds into the run a flooding actor drops to a gentle pace
+        — the recovery window the ``overload`` alert must RESOLVE in
+        (a drill that ends mid-overload can't tell resolution from a
+        stuck alert).  Only flooding actors switch; paced actors keep
+        their rate throughout."""
+        if flood or (slow_slot and i == 0):
+            return seconds * 0.55
+        return -1.0
+
     fleet = [
         SyntheticActor(
-            ("127.0.0.1", port), slot=i, pace=0.002,
+            ("127.0.0.1", port), slot=i, pace=_pace(i),
+            calm_at=_calm_at(i),
             poison_every=poison_every,
             stall_at=(50 + int(rng.integers(100))
                       if i == stall_slot else -1),
             stall_s=2.5,
             client_kwargs=dict(
                 reconnect_timeout=reconnect_timeout,
-                heartbeat_interval=0.5,
+                heartbeat_interval=(0.3 if flow_drill else 0.5),
                 faults=FaultInjector.random(
                     seed * 1000 + i,
                     rates=fault_rates, name=f"actor-{i}"),
@@ -292,9 +514,17 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
     incarnation_high: Dict[int, int] = {}
     learner_step = 0
     stall_seen = False
+    ingest_paused = False
     while time.monotonic() < deadline:
         time.sleep(0.1)
         elapsed = time.monotonic() - t_start
+        if (ingest is not None and slow_ingest > 0 and not ingest_paused
+                and elapsed >= slow_ingest_at):
+            # the --slow-learner-ingest event: the drain freezes for
+            # ``slow_ingest`` seconds mid-run; pressure must climb, the
+            # governor must engage, and everything must recover after
+            ingest.pause(slow_ingest)
+            ingest_paused = True
         stalled = (learner_stall > 0
                    and learner_stall_at <= elapsed
                    < learner_stall_at + learner_stall)
@@ -358,6 +588,15 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
     fenced += gw.fenced
     quarantined += sum(gw.quarantined.values())
     gw.close()
+    for k, old in drill_env_saved.items():  # clients are done: restore
+        if old is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = old
+    if ingest is not None:
+        # flush the paced drain's remaining backlog into the delivery
+        # log: from here on, "still in flight" is not a ledger bucket
+        ingest.close()
 
     # ---- alert-plane verdict (ISSUE 10): expected alerts must have
     # fired AND resolved; anything else firing is a violation
@@ -369,6 +608,11 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
         unresolved = sorted(a["rule"] for a in snap
                             if a["state"] in ("pending", "firing"))
         expected = ["learner_stall"] if stall_seen else []
+        if flow_drill:
+            # the overload drills' alert contract: the flow rule must
+            # fire during the event AND resolve after recovery; the
+            # learner rule (a healthy simulated learner) must stay quiet
+            expected = ["overload"]
         unexpected = [r for r in fired if r not in expected]
         if unexpected:
             violations.append(
@@ -402,7 +646,10 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
     seen = log.seen()
     acked = [t for a in fleet for t in a.acked_tags]
     lost = [t for t in acked if t not in seen]
-    if lost:
+    if lost and not flow_drill:
+        # flow drills shed on purpose (ring drops / gateway tier-3) —
+        # their loss accounting is the conservation ledger below, not
+        # the per-tag at-least-once check
         violations.append(f"{len(lost)} acked chunks never delivered "
                           f"(first: {lost[:5]})")
     poisoned_sent = sum(a.poisoned_sent for a in fleet)
@@ -414,6 +661,86 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
         violations.append(
             f"{poisoned_sent} poisoned chunks sent but the gateway "
             f"quarantined none")
+
+    # ---- flow-plane verdict (ISSUE 11): the overload drills' extra
+    # invariant set — degradation engaged, memory stayed bounded, and
+    # every minted row is in exactly one ledger bucket
+    flow_report: dict = {}
+    if flow_drill and gw.flow is not None:
+        gov = gw.flow.governor
+        minted = sum(a.client.flow_minted_rows for a in fleet if a.client)
+        dropped = sum(a.client.flow_ring.dropped_rows
+                      for a in fleet if a.client)
+        buffered = sum(a.client.flow_ring.buffered_rows
+                       for a in fleet if a.client)
+        ring_high = max((a.client.flow_ring.buffered_high
+                         for a in fleet if a.client), default=0)
+        ring_bound = max((a.client.flow_ring.max_chunks
+                          for a in fleet if a.client), default=1)
+        gw_shed = sum(gw.flow.shed_rows.values())
+        delivered = len(log.tags) + log.poisoned_delivered
+        accounted = delivered + dropped + gw_shed + quarantined + buffered
+        drop_share = {}
+        for a in fleet:
+            if a.client:
+                for actor_id, n in a.client.flow_ring.dropped_by_actor.items():
+                    drop_share[actor_id] = drop_share.get(actor_id, 0) + n
+        for s, n in gw.flow.shed_rows.items():
+            drop_share[s] = drop_share.get(s, 0) + n
+        total_drops = sum(drop_share.values())
+        flow_report = {
+            "state": gov.state,
+            "tier": gov.tier,
+            "transitions": gov.transitions,
+            "minted": minted,
+            "delivered": delivered,
+            "dropped_client": dropped,
+            "shed_gateway": gw_shed,
+            "quarantined": quarantined,
+            "buffered_client": buffered,
+            "accounted": accounted,
+            "balanced": bool(minted == accounted),
+            "client_ring_high": ring_high,
+            "ingest_backlog_high": ingest.backlog_high,
+            "shed_counts": flow_mod.shed_counts(),
+            # who paid for the overload, next to replay/actor_share in
+            # the data X-ray: per-actor share of every counted drop
+            "drop_share": ({str(aid): round(n / total_drops, 4)
+                            for aid, n in sorted(drop_share.items())}
+                           if total_drops else {}),
+        }
+        if minted != accounted:
+            violations.append(
+                f"conservation breached: minted {minted} != delivered "
+                f"{delivered} + dropped {dropped} + gw-shed {gw_shed} "
+                f"+ quarantined {quarantined} + buffered {buffered} "
+                f"= {accounted} (uncounted drop somewhere)")
+        if gov.transitions == 0:
+            violations.append(
+                "overload never engaged: the governor sat in 'healthy' "
+                "through the whole drill (nothing was tested)")
+        if ring_high > ring_bound + 1:
+            violations.append(
+                f"client ring exceeded its bound: high-water "
+                f"{ring_high} > {ring_bound} chunks")
+        if ingest.backlog_high > ingest.bound * 8:
+            violations.append(
+                f"ingest backlog unbounded: high-water "
+                f"{ingest.backlog_high} chunks vs bound {ingest.bound} "
+                f"(flow control never bit)")
+        if slow_slot:
+            # fairness: the runaway (slot 0) must not starve its calm
+            # neighbours — their sends ride their OWN token buckets
+            for a in fleet:
+                if a.slot == 0 or not a.client:
+                    continue
+                m = a.client.flow_minted_rows
+                ak = a.client.flow_acked_rows
+                if m and ak < 0.7 * m:
+                    violations.append(
+                        f"fairness breached: calm slot {a.slot} got "
+                        f"only {ak}/{m} rows through "
+                        f"({ak / m:.0%} < 70%)")
     report = {
         "violations": violations,
         "actors": actors,
@@ -430,6 +757,7 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
         "fenced": fenced,
         "final_learner_step": learner_step,
         "alerts": alert_report,
+        "flow": flow_report,
         "port": port,
     }
     if verbose:
@@ -469,6 +797,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                     metavar="SECS",
                     help="seconds into the run the learner stall "
                          "starts")
+    ap.add_argument("--flood", action="store_true",
+                    help="overload drill (ISSUE 11): every actor "
+                         "pushes flat-out at a slow simulated learner "
+                         "ingest — the credit plane must throttle/shed "
+                         "(counted), the overload alert must fire and "
+                         "resolve, and the conservation ledger must "
+                         "balance exactly")
+    ap.add_argument("--slow-learner-ingest", type=float, default=0.0,
+                    metavar="SECS",
+                    help="overload drill: freeze the learner-side "
+                         "ingest drain for SECS mid-run (0 disables); "
+                         "same verdict set as --flood")
+    ap.add_argument("--slow-ingest-at", type=float, default=3.0,
+                    metavar="SECS",
+                    help="seconds into the run the ingest freeze "
+                         "starts")
+    ap.add_argument("--slow-slot", action="store_true",
+                    help="overload drill: ONE runaway actor floods "
+                         "while its neighbours pace normally — the "
+                         "per-slot fairness drill (calm slots must get "
+                         ">= 70%% of their rows through)")
     ap.add_argument("--log-dir", type=str, default=None,
                     help="leave the production artifact set (blackbox "
                          "rings with alert transitions, alert/* "
@@ -483,6 +832,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                   poison_every=args.poison_every,
                   learner_stall=args.learner_stall,
                   learner_stall_at=args.learner_stall_at,
+                  flood=args.flood,
+                  slow_ingest=args.slow_learner_ingest,
+                  slow_ingest_at=args.slow_ingest_at,
+                  slow_slot=args.slow_slot,
                   log_dir=args.log_dir, port=args.port)
     ok = not report["violations"]
     print(f"[chaos] {'OK' if ok else 'FAILED'} after {args.seconds:.0f}s: "

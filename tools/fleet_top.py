@@ -249,6 +249,48 @@ def actor_line(status: dict) -> Optional[str]:
     return f"  actors[{backend}]: " + " · ".join(bits)
 
 
+def flow_line(status: dict) -> Optional[str]:
+    """One panel line for the ISSUE-11 flow-control plane: the STATUS
+    ``flow`` block (gateway GatewayFlow.status_block) — overload state
+    + brownout tier, per-slot credit grants, counted drops with each
+    slot's share of the overload cost (next to the data X-ray's
+    ``replay/actor_share``), and the conservation-ledger verdict."""
+    f = status.get("flow")
+    if not f:
+        return None
+    state = str(f.get("state", "?"))
+    head = state.upper() if state != "healthy" else "healthy"
+    if f.get("tier"):
+        head += f" tier {f['tier']}"
+    bits = [head, f"pressure {f.get('pressure', 0.0):g}"]
+    credits = f.get("credits") or {}
+    if credits:
+        bits.append("credits " + " ".join(
+            f"s{s}={c}" for s, c in sorted(credits.items(),
+                                           key=lambda kv: int(kv[0]))))
+    drops: Dict[str, int] = {}
+    for s, r in (f.get("client") or {}).items():
+        drops[s] = drops.get(s, 0) + int(r.get("dropped", 0))
+    for s, n in (f.get("shed_rows") or {}).items():
+        drops[s] = drops.get(s, 0) + int(n)
+    total = sum(drops.values())
+    if total:
+        share = f.get("drop_share") or {}
+        bits.append("dropped " + " ".join(
+            f"s{s}={n}" + (f" ({share[s]:.0%})" if s in share else "")
+            for s, n in sorted(drops.items(), key=lambda kv: int(kv[0]))
+            if n))
+    else:
+        bits.append("0 dropped")
+    cons = f.get("conservation") or {}
+    if "balanced" in cons:
+        bits.append("ledger " + ("ok" if cons["balanced"] else
+                                 f"IMBALANCED ({cons.get('minted')} "
+                                 f"minted vs {cons.get('accounted')} "
+                                 f"accounted)"))
+    return "  flow: " + " · ".join(bits)
+
+
 def render(status: dict,
            metrics_latest: Optional[Dict[str, float]] = None) -> str:
     """One snapshot as a plain-text panel (no curses: works in any
@@ -290,6 +332,9 @@ def render(status: dict,
     alline = alerts_line(status)
     if alline:
         lines.append(alline)
+    fline = flow_line(status)
+    if fline:
+        lines.append(fline)
     lines.extend(series_lines(status))
     # health sentinel (utils/health.py): guard skips / rollbacks / hang
     # kills from the learner host, quarantine counts split by boundary —

@@ -63,6 +63,10 @@ _DEFAULT_SCALAR_PREFIXES = (
     # the mission-control engine — the scalar-stream leg of an alert
     # transition; the blackbox leg is the "alert" event kind below
     "alert/",
+    # ISSUE 11: overload-state / brownout-tier rows from the flow
+    # governor — the scalar leg the ``overload_shed`` rule watches;
+    # the blackbox leg is the "overload" event kind below
+    "flow/",
 )
 
 # blackbox event kinds that mark the *incident* skeleton — rendered
@@ -71,6 +75,10 @@ _LOUD_KINDS = {
     "fault", "rollback", "anomaly", "dump", "dcn-terminal", "reconnect",
     "divergence-fatal", "quarantine", "hang-kill", "preemption",
     "session-start", "prefetch-failed", "alert",
+    # ISSUE 11: overload-governor state/tier transitions and the
+    # gateway's tier-3 experience sheds — the incident skeleton of an
+    # overload event, clock-aligned with the alerts it should trigger
+    "overload", "flow-shed", "brownout",
 }
 
 
