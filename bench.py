@@ -100,6 +100,7 @@ Usage: ``python bench.py [--mode micro|families|e2e|both] [--smoke]``
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import functools
 import json
 import os
@@ -1617,6 +1618,207 @@ def bench_device_env(ns=(64, 256, 1024), scan_ticks: int = 8,
     return {"device_env": out}
 
 
+def bench_anakin(pairs: int = 10, envs: int = 16, ticks: int = 8,
+                 smoke: bool = False) -> dict:
+    """The ISSUE-12 closed-loop section: the co-located Anakin driver
+    (agents/anakin.py — env fleet + learner in ONE process, the fused
+    rollout scattering straight into the HBM PER ring, zero host work
+    on the experience path) against the split-process ``device``
+    backend's host plumbing driving the SAME XLA programs (chunk D2H
+    -> per-row feeder -> spawn queue -> ingest drain -> fused learner
+    step — the ~56 KB/transition wall BENCH_r03 measured).
+
+    Both legs run the same strict-alternation schedule (one rollout
+    dispatch, one learner dispatch, ``pairs`` times) on the same
+    geometry, so ``speedup_vs_device`` is purely the host plumbing the
+    co-location deletes.  ``duty_cycle`` is the rollout share of busy
+    time (the ``anakin/duty_cycle`` telemetry tag's exact definition);
+    frames/s counts ALL env frames over the pair wall clock — the
+    e2e-loop rate, not the rollout-only ceiling the device_env section
+    reports.  ``smoke=True`` shrinks the fleet to seconds-scale and
+    skips the split leg (one compile instead of three); the smoke
+    output rides ``smoke.anakin_frames_per_sec`` into the gate."""
+    import jax
+
+    from pytorch_distributed_tpu.agents.anakin import AnakinDriver
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock, LearnerStats,
+    )
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.agents.param_store import (
+        ParamStore, make_flattener,
+    )
+    from pytorch_distributed_tpu.factory import (
+        build_memory, build_model, init_params, probe_env,
+    )
+
+    if smoke:
+        pairs, envs, ticks = 4, 8, 6
+
+    def make_opt(root, **over):
+        # config 12 (pong-sim + HBM PER ring) with the mlp head: the
+        # cnn forward would drown the plumbing delta on a CPU host (the
+        # device_env section's policy_bound flag), and the ring schema
+        # pins uint8 to match the device env's frames (the config-12
+        # cnn default; the mlp default would flip it to float32)
+        base = dict(
+            root_dir=root, refs="bench_anakin", num_actors=1,
+            num_envs_per_actor=envs, actor_backend="anakin",
+            visualize=False, model_type="dqn-mlp", state_dtype="uint8",
+            nstep=4, memory_size=4096, learn_start=64, batch_size=32,
+            steps=10 ** 9, early_stop=50, actor_freq=10 ** 9,
+            learner_freq=10 ** 9, param_publish_freq=10 ** 9,
+            checkpoint_freq=10 ** 9)
+        base.update(over)
+        opt = build_options(config=12, **base)
+        opt.env_params.device_rollout_ticks = ticks
+        return opt
+
+    # ---- leg A: the co-located driver ----
+    root_a = tempfile.mkdtemp(prefix="bench_anakin_")
+    opt = make_opt(root_a)
+    spec = probe_env(opt)
+    handles = build_memory(opt, spec)
+    model = build_model(opt, spec)
+    flat0, _ = make_flattener(init_params(opt, spec, model,
+                                          seed=opt.seed))
+    drv = AnakinDriver(opt, spec, handles.learner_side,
+                       ParamStore(flat0.size), GlobalClock(),
+                       LearnerStats(), actor_stats=ActorStats())
+    drv.dispatch_rollout()   # compile both programs outside the window
+    drv.dispatch_learn()
+    drv._roll_s = drv._learn_s = 0.0
+    t0 = time.perf_counter()
+    for _ in range(pairs):
+        drv.dispatch_rollout()
+        drv.dispatch_learn()
+    jax.block_until_ready(drv.state.params)
+    wall = time.perf_counter() - t0
+    frames = pairs * ticks * envs
+    updates = pairs * drv.K_learn
+    busy = drv._roll_s + drv._learn_s
+    out = {
+        "frames_per_sec": round(frames / wall, 1),
+        "updates_per_sec": round(updates / wall, 2),
+        "duty_cycle": round(drv._roll_s / busy, 4) if busy else None,
+        "pairs": pairs,
+        "geometry": f"dqn-mlp head, {envs} envs x {ticks} ticks, "
+                    f"uint8 HBM PER ring (config 12)",
+    }
+    drv.writer.close()
+    handles.learner_side.close()
+    print(f"[bench_anakin] co-located: {out}", file=sys.stderr,
+          flush=True)
+
+    if not smoke:
+        out["split_frames_per_sec"] = _anakin_split_leg(
+            make_opt, pairs, envs, ticks)
+        out["speedup_vs_device"] = round(
+            out["frames_per_sec"] / out["split_frames_per_sec"], 2)
+        print(f"[bench_anakin] split-process: "
+              f"{out['split_frames_per_sec']} f/s "
+              f"(speedup {out['speedup_vs_device']}x)",
+              file=sys.stderr, flush=True)
+    return {"anakin": out}
+
+
+def _anakin_split_leg(make_opt, pairs: int, envs: int,
+                      ticks: int) -> float:
+    """The split-process ``actor_backend="device"`` loop's pieces in
+    one process, driven to the same strict-alternation schedule as the
+    co-located leg: chunk-emit rollout -> device_get -> per-row feeder
+    (the device actor loop's exact feed path) -> spawn queue -> ingest
+    drain -> fused learner step."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.factory import (
+        build_device_env, build_memory, build_model,
+        build_train_state_and_step, init_params, probe_env,
+    )
+    from pytorch_distributed_tpu.models.policies import (
+        apex_epsilons, build_fused_rollout, init_rollout_carry,
+    )
+    from pytorch_distributed_tpu.utils.experience import (
+        Transition, make_prov,
+    )
+    from pytorch_distributed_tpu.utils.rngs import np_rng, process_key
+
+    root = tempfile.mkdtemp(prefix="bench_anakin_split_")
+    opt = make_opt(root, actor_backend="device")
+    ap = opt.agent_params
+    spec = probe_env(opt)
+    ingest = build_memory(opt, spec).learner_side
+    model = build_model(opt, spec)
+    params = init_params(opt, spec, model, seed=opt.seed)
+    state, step_fn = build_train_state_and_step(opt, spec, model, params)
+    ring = ingest.attach()
+    fused = ring.build_fused_step(step_fn, ap.batch_size,
+                                  donate=opt.parallel_params.donate,
+                                  steps_per_call=1)
+    device_key = jax.random.PRNGKey(
+        np_rng(opt.seed, "learner", 0).integers(2 ** 31))
+    env = build_device_env(opt, 0, envs)
+    roll = build_fused_rollout(model.apply, env, nstep=ap.nstep,
+                               gamma=ap.gamma, rollout_ticks=ticks,
+                               emit="chunk")
+    carry = init_rollout_carry(env, ap.nstep)
+    base_key = jnp.asarray(process_key(opt.seed, "actor", 0))
+    eps = jnp.asarray(apex_epsilons(0, 1, envs, ap.eps, ap.eps_alpha),
+                      jnp.float32)
+    feeder = ingest.make_feeder()
+    tick0 = jnp.int32(0)
+    fed_expected = 0
+
+    def pair(k):
+        nonlocal carry, tick0, state, device_key, fed_expected
+        carry, chunk = roll(state.params, carry, base_key, tick0, eps)
+        tick0 = tick0 + ticks
+        ch = jax.device_get(chunk)   # the split path's chunk D2H
+        valid = np.asarray(ch.valid)
+        for t in range(ticks):
+            for j in range(envs):
+                if not valid[t, j]:
+                    continue
+                feeder.feed(Transition(
+                    state0=ch.state0[t, j], action=ch.action[t, j],
+                    reward=ch.reward[t, j], gamma_n=ch.gamma_n[t, j],
+                    state1=ch.state1[t, j],
+                    terminal1=ch.terminal1[t, j],
+                    prov=make_prov(0, j, 0, k)), None)
+                fed_expected += 1
+        feeder.flush()
+        # drain until THIS dispatch's transitions have all landed in
+        # the ring — the freshness the co-located loop gives by
+        # construction (each learn samples the rollout it just ran).
+        # Letting the queue lag instead hides the plumbing behind the
+        # learner's XLA time on an idle core, at the price of sampling
+        # stale data — exactly the Podracer trade this section exists
+        # to measure.  The geometry keeps every dispatch's emission
+        # count a multiple of the smallest feeder chunk (64) so the
+        # drain can fully settle.
+        deadline = time.monotonic() + 30.0
+        while ingest._fed_total < fed_expected \
+                and time.monotonic() < deadline:
+            ingest.drain()
+            time.sleep(0.001)
+        keys = jax.random.split(device_key, 2)
+        device_key = keys[0]
+        beta = jax.device_put(np.float32(ring.beta(k)))
+        new_state, ring.state, _m = fused(state, ring.state, keys[1],
+                                          beta)
+        return new_state
+
+    state = pair(0)   # compile outside the window
+    t0 = time.perf_counter()
+    for k in range(pairs):
+        state = pair(k + 1)
+    jax.block_until_ready(state.params)
+    wall = time.perf_counter() - t0
+    ingest.close()
+    return round(pairs * ticks * envs / wall, 1)
+
+
 def bench_e2e(seconds: float = 60.0, actors: int = 1,
               envs_per_actor: int = 16,
               actor_backend: str | None = None) -> dict:
@@ -1641,12 +1843,13 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
         # with an accelerator present the learner parent owns it and can
         # host the SEED-style inference batcher — actor ticks stop being
         # host-CPU convnet forwards (ISSUE 4); CPU-only hosts run the
-        # ISSUE-7 device actor plane: the env fleet is a pure-JAX scan
-        # fused with the policy, so NO host env step exists at all (the
-        # config-8 pong-sim env has a device implementation)
+        # ISSUE-12 CLOSED loop: env fleet + learner co-located in one
+        # process, zero spawn-queue/D2H work on the experience path
+        # (the config-8 pong-sim env has a device implementation and
+        # the config-8 memory is the HBM ring anakin scatters into)
         actor_backend = ("batched"
                          if jax.devices()[0].platform != "cpu"
-                         else "device")
+                         else "anakin")
 
     t_start = time.perf_counter()
 
@@ -1662,6 +1865,18 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
         actor_backend=actor_backend,
         evaluator_nepisodes=0,  # no evaluator process in the bench
         steps=10 ** 9, max_seconds=seconds + 45.0)
+    if actor_backend == "anakin" and jax.devices()[0].platform == "cpu":
+        # duty-cycle setpoint for the CPU image: the split-process
+        # backends' actors free-run while the CNN learner trails far
+        # behind (BENCH_r03: ~470 f/s against ~1 update/s — replay
+        # ratio << 1), so the comparable anakin schedule is the same
+        # data-rich regime, ~4 frames collected per sampled-batch row.
+        # Strict alternation (ratio 0, the default) is the TPU
+        # operating point: there the learn dispatch is ms-scale and
+        # alternation keeps the chip saturated either way.
+        opt.anakin_params = dataclasses.replace(
+            opt.anakin_params, rollout_ratio=4.0 * opt.agent_params.
+            batch_size)
 
     # The topology (and its child processes) write progress to fd 1; the
     # driver contract is ONE JSON line on stdout, so point fd 1 at stderr
@@ -1742,6 +1957,18 @@ def bench_e2e(seconds: float = 60.0, actors: int = 1,
         out["e2e_actor_plane"] = (
             "device rollout (fused env+policy+nstep scan) — actor "
             "plane no longer bound by the host env step")
+    elif actor_backend == "anakin":
+        # the ISSUE-12 read: there is no actor PROCESS at all — the
+        # learner process hosts the env fleet and alternates the fused
+        # rollout (scattering in-graph into its own HBM ring) with the
+        # fused learner step; no host env step, no spawn queue, no
+        # D2H on the experience path.  What binds e2e now is the
+        # learner-side FLOPs (rollout forward + train step) alone.
+        out["e2e_host_env_step_ms"] = 0.0
+        out["e2e_actor_plane"] = (
+            "anakin co-located loop (env fleet in the learner "
+            "process, in-graph replay scatter) — e2e is "
+            "learner-FLOPs-bound, zero experience-path transfers")
     return out
 
 
@@ -1750,7 +1977,8 @@ def main() -> None:
     ap.add_argument("--mode", choices=("micro", "e2e", "both", "families",
                                        "sampler", "act", "actor",
                                        "health", "perf", "device_env",
-                                       "provenance", "metrics", "flow"),
+                                       "provenance", "metrics", "flow",
+                                       "anakin"),
                     default="both")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale CPU-safe bench (the dqn-mlp "
@@ -1761,10 +1989,11 @@ def main() -> None:
     ap.add_argument("--e2e-actors", type=int, default=1)
     ap.add_argument("--e2e-envs", type=int, default=16)
     ap.add_argument("--e2e-actor-backend", type=str, default=None,
-                    choices=("inline", "pipelined", "batched", "device"),
+                    choices=("inline", "pipelined", "batched", "device",
+                             "anakin"),
                     help="override the e2e actor schedule (default: "
                          "batched on accelerator hosts, else the "
-                         "ISSUE-7 device env fleet)")
+                         "ISSUE-12 co-located anakin loop)")
     ap.add_argument("--actor-envs", type=int, default=16,
                     help="env-vector width for the actor-pipeline section")
     ap.add_argument("--actor-ticks", type=int, default=300)
@@ -1795,6 +2024,12 @@ def main() -> None:
         # ISSUE-11 flow-plane overhead rides the smoke output the same
         # way (additive key, schema stays 4)
         result.update(bench_flow_overhead(smoke=True))
+        # ISSUE-12 co-located loop: the closed rollout+learn pair rate
+        # on a tiny fleet (additive key, schema stays 4; the full
+        # section with the split-process comparison runs under --mode
+        # anakin/both)
+        result["smoke"]["anakin_frames_per_sec"] = \
+            bench_anakin(smoke=True)["anakin"]["frames_per_sec"]
         out = {
             "bench_schema": 4,
             "metric": "smoke_updates_per_sec",
@@ -1831,6 +2066,8 @@ def main() -> None:
                                            args.actor_ticks))
     if args.mode in ("both", "device_env"):
         result.update(bench_device_env())
+    if args.mode in ("both", "anakin"):
+        result.update(bench_anakin())
     if args.mode in ("e2e", "both"):
         result.update(bench_e2e(args.e2e_seconds, args.e2e_actors,
                                 args.e2e_envs, args.e2e_actor_backend))

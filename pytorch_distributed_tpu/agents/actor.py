@@ -36,6 +36,12 @@ per-tick randomness is a pure function of (actor, tick, env row):
   co-located server; downgrades to ``pipelined`` with a warning when
   none is wired in (e.g. remote DCN actor hosts).
 
+A fourth backend, ``device`` (ISSUE 7), replaces the per-tick loop with
+the fused on-device rollout below; and a fifth, ``anakin`` (ISSUE 12),
+removes the actor process entirely — the env fleet lives in the learner
+process and agents/anakin.py drives the same fused rollout against the
+learner's own replay ring, so no actor worker ever spawns.
+
 Cadences mirror the reference: stats pushed every ``actor_freq`` env steps
 (reference dqn_actor.py:180-192), global actor-step counter advanced per
 env step (reference :166-167), loop until the global learner clock reaches
@@ -583,6 +589,30 @@ def _drive_actor_loop(h: _ActorHarness, engine, clock: GlobalClock,
     return h
 
 
+def fold_rollout_episode_stats(step_reward, step_terminal, episode_reward,
+                               episode_steps, acc: dict) -> None:
+    """Fold a fused dispatch's ``(K, N)`` per-tick env stats into the
+    harness-style per-env episode accumulators and the actor stat dict
+    (``ActorStats.FIELDS`` keys) — ONE implementation shared by the
+    split-process device actor loop and the co-located Anakin driver
+    (agents/anakin.py), so the two backends' episode curves can never
+    drift.  ``episode_reward``/``episode_steps`` are mutated in place;
+    an episode counts as solved when its return is positive (the
+    ``_record_episode`` default for envs that report no ``solved``)."""
+    K = np.asarray(step_reward).shape[0]
+    for k in range(K):
+        episode_reward += np.asarray(step_reward[k], np.float64)
+        episode_steps += 1
+        for j in np.nonzero(np.asarray(step_terminal[k]))[0]:
+            j = int(j)
+            acc["nepisodes"] += 1
+            acc["nepisodes_solved"] += float(episode_reward[j] > 0)
+            acc["total_steps"] += float(episode_steps[j])
+            acc["total_reward"] += float(episode_reward[j])
+            episode_steps[j] = 0
+            episode_reward[j] = 0.0
+
+
 def _drive_device_actor_loop(h: _ActorHarness, clock: GlobalClock,
                              base_key, eps) -> _ActorHarness:
     """The Sebulba actor loop (ISSUE 7): no per-tick host work at all.
@@ -705,12 +735,11 @@ def _drive_device_actor_loop(h: _ActorHarness, clock: GlobalClock,
                                        birth_step))
                     h.memory.feed(t, prio[k][j] if prio is not None
                                   else None)
-                # episode accounting off the per-tick env stats
-                h.episode_reward += np.asarray(ch.step_reward[k],
-                                               np.float64)
-                h.episode_steps += 1
-                for j in np.nonzero(np.asarray(ch.step_terminal[k]))[0]:
-                    h._record_episode(int(j), {})
+            # episode accounting off the per-tick env stats (shared
+            # with the Anakin driver: fold_rollout_episode_stats)
+            fold_rollout_episode_stats(ch.step_reward, ch.step_terminal,
+                                       h.episode_reward, h.episode_steps,
+                                       h._acc)
             h._flush_cadence()
     h.shutdown()
     return h
@@ -724,6 +753,11 @@ def run_dqn_actor(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     from pytorch_distributed_tpu.models.policies import apex_epsilons
 
     backend = resolve_actor_backend(opt, inference)
+    if backend == "anakin":
+        # an actor PROCESS can never be the co-located loop (that loop
+        # is the learner); remote hosts in a hybrid anakin fleet run
+        # the split-process device schedule against the same env fleet
+        backend = "device"
     h = _ActorHarness(opt, spec, process_ind, memory, param_store, clock,
                       stats, backend=backend)
     eps = apex_epsilons(process_ind, opt.num_actors, h.num_envs,

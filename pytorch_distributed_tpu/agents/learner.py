@@ -56,6 +56,19 @@ from pytorch_distributed_tpu.utils.rngs import np_rng, process_seed
 def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                 param_store: ParamStore, clock: GlobalClock,
                 stats: LearnerStats) -> None:
+    from pytorch_distributed_tpu.factory import anakin_active
+
+    if anakin_active(opt):
+        # the co-located Anakin topology (ISSUE 12): this process IS
+        # the actor fleet too — delegate to the duty-cycle driver.
+        # Direct callers land here; the runtime dispatches earlier so
+        # it can hand the shared ActorStats in (runtime.Topology.run).
+        from pytorch_distributed_tpu.agents.anakin import (
+            run_anakin_learner,
+        )
+
+        return run_anakin_learner(opt, spec, process_ind, memory,
+                                  param_store, clock, stats)
     import jax
     import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree

@@ -109,6 +109,9 @@ KNOBS = (
     ("TPU_APEX_FLOW_*", "utils/flow.py",
      "per-field FlowParams overrides (e.g. TPU_APEX_FLOW_LOCAL_POLICY, "
      "TPU_APEX_FLOW_CLIENT_RING)"),
+    ("TPU_APEX_ANAKIN_*", "agents/anakin.py",
+     "per-field AnakinParams overrides (e.g. TPU_APEX_ANAKIN_ROLLOUT_RATIO, "
+     "TPU_APEX_ANAKIN_DOUBLE_BUFFER)"),
 )
 
 
@@ -164,6 +167,20 @@ class EnvParams:
     #                 finished transition chunk.  dqn families with a
     #                 device env implementation only (pong-sim);
     #                 downgrades to "pipelined" otherwise.
+    #   "anakin"    — the CLOSED Anakin loop (ISSUE 12): the env fleet
+    #                 lives IN the learner process and one driver
+    #                 alternates the donated fused rollout (emit=
+    #                 "replay", scattering straight into the device
+    #                 replay ring) with the fused learner dispatch
+    #                 against the same HBM ring — no actor processes,
+    #                 no spawn queue, no D2H on the experience path at
+    #                 all (agents/anakin.py).  The acting params ARE the
+    #                 train state's params (the published version is the
+    #                 acting version by construction).  dqn + a device
+    #                 env implementation + a device replay ring
+    #                 (memory_type "device"/"device-per") only;
+    #                 downgrades to "device" otherwise.  Knobs:
+    #                 AnakinParams.
     actor_backend: str = "pipelined"
     # Ticks per fused device rollout dispatch (actor_backend="device"):
     # K env steps of all N envs run inside one XLA program, amortizing
@@ -597,6 +614,44 @@ class FlowParams:
 
 
 @dataclass
+class AnakinParams:
+    """Co-located Anakin-loop knobs (ISSUE 12; agents/anakin.py — no
+    reference equivalent: the reference always runs actors as separate
+    processes).  Every field is env-overridable as
+    ``TPU_APEX_ANAKIN_<FIELD>`` via ``anakin.resolve_anakin``, the same
+    spawn-inheritance contract the health/perf/flow planes use.  Active
+    only under ``env_params.actor_backend="anakin"``."""
+
+    # Duty-cycle setpoint: target env frames collected per learner
+    # update.  The scheduler dispatches rollouts while
+    # ``frames < updates * rollout_ratio`` (after the min-fill warmup)
+    # and learner steps otherwise.  0 = strict alternation: one rollout
+    # dispatch, one learner dispatch, repeat.
+    rollout_ratio: float = 0.0
+    # Ring rows required before the FIRST learner dispatch (per half in
+    # double-buffer mode).  0 = derive from agent_params.learn_start
+    # (clamped to the ring/half capacity like the learner's warmup
+    # gate).
+    min_fill: int = 0
+    # Double-buffered replay halves: the ring is split into two
+    # half-capacity rings — learner dispatches sample the STABLE half
+    # while rollouts scatter into the other; the halves swap once the
+    # write half holds ``min_fill`` fresh rows.  Sampling never reads a
+    # row the current rollout cycle is writing, and the PER priority
+    # write-back lands in the sample half only — write races are
+    # excluded by construction, not by ordering.  Costs replay
+    # diversity (each dispatch samples from half the history), so the
+    # default is the strict alternation of ONE ring, where dispatch
+    # ordering already serializes writers and readers.
+    double_buffer: bool = False
+    # Drain the cross-process ingest queue between dispatches (chunks
+    # from remote DCN actor hosts landing at the gateway).  The
+    # co-located fleet itself never touches the queue; this keeps a
+    # hybrid topology (anakin learner + remote device actors) live.
+    drain_ingest: bool = True
+
+
+@dataclass
 class ParallelParams:
     """TPU topology knobs — no reference equivalent (the reference is a
     single-node torch.multiprocessing program, SURVEY.md §2); this is where
@@ -673,6 +728,7 @@ class Options:
     metrics_params: MetricsParams = field(default_factory=MetricsParams)
     alert_params: AlertParams = field(default_factory=AlertParams)
     flow_params: FlowParams = field(default_factory=FlowParams)
+    anakin_params: AnakinParams = field(default_factory=AnakinParams)
 
     @property
     def model_dir(self) -> str:
@@ -766,7 +822,7 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
         for sub in ("env_params", "memory_params", "model_params",
                     "agent_params", "parallel_params", "health_params",
                     "perf_params", "metrics_params", "alert_params",
-                    "flow_params"):
+                    "flow_params", "anakin_params"):
             subobj = getattr(opt, sub)
             if hasattr(subobj, key):
                 hits.append((sub, subobj))

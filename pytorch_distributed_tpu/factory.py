@@ -101,7 +101,43 @@ def get_worker(role: str, agent_type: str) -> Callable:
 # Actor backend routing (ISSUE 4)
 # ---------------------------------------------------------------------------
 
-ACTOR_BACKENDS = ("inline", "pipelined", "batched", "device")
+ACTOR_BACKENDS = ("inline", "pipelined", "batched", "device", "anakin")
+
+
+def anakin_eligible(opt: Options) -> Tuple[bool, str]:
+    """Whether this Options can run the co-located Anakin loop (ISSUE
+    12): the dqn family, a pure-JAX env implementation, a device replay
+    ring for the in-graph scatter, and NCHW ring storage (the fused
+    rollout scatters raw rows; the NHWC ingest transpose lives on the
+    host feed path it bypasses).  Returns ``(ok, reason)`` so callers
+    can warn with the actual blocker."""
+    from pytorch_distributed_tpu.envs.device_env import (
+        device_env_supported,
+    )
+
+    if opt.agent_type != "dqn":
+        return False, f"agent_type={opt.agent_type} (dqn only)"
+    if not device_env_supported(opt.env_params):
+        return False, (f"env_type={opt.env_params.env_type!r} has no "
+                       f"device env implementation")
+    if opt.memory_type not in ("device", "device-per"):
+        return False, (f"memory_type={opt.memory_type!r} (the fused "
+                       f"rollout scatters into a device ring: use "
+                       f"'device' or 'device-per')")
+    if device_ring_channels_last(opt):
+        return False, ("device_channels_last=true (the in-graph scatter "
+                       "writes NCHW rows)")
+    return True, ""
+
+
+def anakin_active(opt: Options) -> bool:
+    """Whether the topology runs the co-located Anakin loop — the env
+    fleet lives in the learner process, NO actor workers spawn, and the
+    learner delegates to agents/anakin.run_anakin_learner.  One
+    predicate shared by the topology (worker table), the learner (loop
+    dispatch) and the fleet CLI so the pieces can never disagree."""
+    return (getattr(opt.env_params, "actor_backend", "") == "anakin"
+            and anakin_eligible(opt)[0])
 
 
 def resolve_actor_backend(opt: Options, inference=None) -> str:
@@ -139,6 +175,20 @@ def resolve_actor_backend(opt: Options, inference=None) -> str:
                 "in (remote actor host, or a topology without the "
                 "server); falling back to pipelined", stacklevel=2)
             return "pipelined"
+    if backend == "anakin":
+        import warnings
+
+        ok, why = anakin_eligible(opt)
+        if ok:
+            return "anakin"
+        # ineligible: fall through the device backend's own gates (the
+        # config.py EnvParams contract: anakin downgrades to "device",
+        # which itself may downgrade further to "pipelined")
+        warnings.warn(
+            f"actor_backend=anakin is not runnable here ({why}); "
+            f"falling back to the split-process device backend",
+            stacklevel=2)
+        backend = "device"
     if backend == "device":
         import warnings
 

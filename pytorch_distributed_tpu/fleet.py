@@ -276,6 +276,22 @@ class FleetTopology(Topology):
         psnap = perf.status_snapshot()
         if psnap:
             h["perf"] = psnap
+        # anakin panel block (ISSUE 12): the co-located loop's vitals —
+        # duty cycle / rollout rate off the learner monitor's gauges
+        # (present when the perf plane is on), ring fill off the host
+        # accounting either way; fleet_top renders it and the ``--json``
+        # consumers read it verbatim
+        if getattr(self, "anakin", False):
+            snap = (psnap or {}).get("learner", {})
+            h["anakin"] = {
+                "backend": "anakin",
+                "duty_cycle": snap.get("anakin/duty_cycle"),
+                "rollout_frames_per_s":
+                    snap.get("anakin/rollout_frames_per_s"),
+                "replay_fill": snap.get("anakin/replay_fill",
+                                        h.get("replay_fill")),
+                "mfu": snap.get("learner/mfu"),
+            }
         # mission control (ISSUE 10): per-rule alert states + recent
         # fleet series — fleet_top's alert panel/sparklines and the
         # ``--json`` blocks CI asserts on come from HERE, not from the
@@ -657,7 +673,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="[actors] actors to run on this host")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--actor-backend", type=str, default=None,
-                    choices=("inline", "pipelined", "batched", "device"),
+                    choices=("inline", "pipelined", "batched", "device",
+                             "anakin"),
                     help="actor hot-loop schedule (config.py EnvParams."
                          "actor_backend): pipelined = overlapped "
                          "two-stage loop (default), inline = serial "
@@ -669,7 +686,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                          "fleet (pure-JAX envs fused with the policy "
                          "into one scan, envs/device_env.py — dqn + "
                          "device-implemented envs only, others "
-                         "downgrade) (factory.resolve_actor_backend)")
+                         "downgrade); anakin = the CLOSED loop (ISSUE "
+                         "12): env fleet + learner in ONE process, no "
+                         "actor workers on the learner host at all "
+                         "(agents/anakin.py — remote actor hosts in a "
+                         "hybrid fleet run the device schedule) "
+                         "(factory.resolve_actor_backend)")
     ap.add_argument("--resume", type=str, default=None, metavar="REFS",
                     help="[learner] resume run REFS from its newest "
                          "complete checkpoint epoch (models/REFS_ckpt — "

@@ -27,11 +27,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributed_tpu.memory.device_replay import (
-    DeviceReplay, ring_write, round_capacity,
+    DeviceReplay, ring_write, ring_write_masked, round_capacity,
 )
 from pytorch_distributed_tpu.utils.experience import (
     REPLAY_FIELDS, Batch, Transition,
 )
+
+# single-owner declaration (apexlint): the masked PER scatter may only
+# be composed into programs by the replay planes themselves and the
+# fused rollout that receives it as ``ring_write_fn``
+# (models/policies.build_fused_rollout, wired by agents/anakin.py)
+__apex_fn_owners__ = {
+    "per_write_masked": ("memory.", "models.policies", "agents.anakin"),
+}
 
 
 class PerReplayState(NamedTuple):
@@ -54,6 +62,25 @@ def per_feed(state: PerReplayState, chunk: Transition,
     ring_write); new rows take the running max priority."""
     new, idx = ring_write(state, chunk, capacity)
     return new._replace(priority=new.priority.at[idx].set(new.max_priority))
+
+
+def per_write_masked(state: PerReplayState, chunk: Transition, valid,
+                     capacity: int):
+    """Masked-scatter twin of ``per_feed`` for in-graph ingest
+    (device_replay.ring_write_masked semantics): only the ``valid``
+    rows take slots, and every written slot enters at the RUNNING MAX
+    priority — the same everything-replayed-at-least-once contract the
+    queue ingest path applies, so the co-located Anakin scatter and
+    the split-process drain produce bit-identical PER rings.  Returns
+    ``(state', n_written)``."""
+    new, total = ring_write_masked(state, chunk, valid, capacity)
+    # same drop-indexing as the field scatter: invalid rows point at
+    # ``capacity`` (out of bounds) and are dropped branch-free
+    offs = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    idx = jnp.where(valid, (state.pos + offs) % capacity, capacity)
+    return new._replace(
+        priority=new.priority.at[idx].set(new.max_priority,
+                                          mode="drop")), total
 
 
 def per_sample(state: PerReplayState, key: jax.Array, batch_size: int,

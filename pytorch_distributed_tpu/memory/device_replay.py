@@ -399,6 +399,9 @@ class DeviceReplayIngest:
         self.max_queue_chunks = max_queue_chunks  # backpressure bound
         self._q = mp.get_context("spawn").Queue(max_queue_chunks)
         self.replay: Optional[DeviceReplay] = None
+        # second half-capacity ring under the Anakin double-buffer mode
+        # (attach_halves); None on every other path
+        self.replay_b: Optional[DeviceReplay] = None
         self._pending: list = []
         self._fed_total = 0
         self._validator = None  # ingest quarantine, built on first drain
@@ -424,22 +427,56 @@ class DeviceReplayIngest:
 
         self._flow_params = flow.resolve_flow(params)
 
+    def _make_replay(self, capacity: int,
+                     mesh: Optional[jax.sharding.Mesh]) -> DeviceReplay:
+        """One construction point for the HBM ring so ``attach`` and the
+        Anakin ``attach_halves`` (and the PER subclass's overrides) can
+        never diverge on geometry."""
+        return DeviceReplay(
+            capacity, self.state_shape, self.action_shape,
+            self.state_dtype, self.action_dtype, mesh=mesh,
+            channels_last=self.channels_last)
+
     def attach(self, mesh: Optional[jax.sharding.Mesh] = None
                ) -> DeviceReplay:
         """Allocate the HBM ring on the learner's mesh (geometry was fixed
         at construction by the memory factory)."""
-        capacity = round_capacity(self.capacity, mesh)
-        self.replay = DeviceReplay(
-            capacity, self.state_shape, self.action_shape,
-            self.state_dtype, self.action_dtype, mesh=mesh,
-            channels_last=self.channels_last)
+        self.replay = self._make_replay(round_capacity(self.capacity, mesh),
+                                        mesh)
         return self.replay
+
+    def attach_halves(self, mesh: Optional[jax.sharding.Mesh] = None
+                      ) -> Tuple[DeviceReplay, DeviceReplay]:
+        """Double-buffer allocation for the co-located Anakin loop
+        (agents/anakin.py, AnakinParams.double_buffer): TWO
+        half-capacity rings instead of one — learner dispatches sample
+        one half while rollouts scatter into the other; the driver owns
+        the swap schedule.  Returns ``(half_a, half_b)``; ``half_a`` is
+        also ``self.replay``, so the cross-process ingest drain (remote
+        DCN rows in a hybrid topology) and the checkpoint snapshot keep
+        working against half A — a documented asymmetry, not a race
+        (the driver treats half A as a normal half)."""
+        cap = round_capacity(max(self.capacity // 2, 1), mesh,
+                             label="anakin half ring")
+        self.replay = self._make_replay(cap, mesh)
+        self.replay_b = self._make_replay(cap, mesh)
+        return self.replay, self.replay_b
+
+    def note_scatter(self, rows: int) -> None:
+        """Account rows written into the attached ring(s) by an
+        in-graph scatter (the co-located Anakin rollout's replay-emit
+        leg) — the zero-copy path never crosses ``drain``, so without
+        this the host-side ``size``/fill reporting (fleet STATUS,
+        checkpoint extras) would read a full ring as empty."""
+        self._fed_total += int(rows)
 
     @property
     def size(self) -> int:
         # host-side accounting — no device sync in the hot loop
         assert self.replay is not None, "attach() first"
-        return min(self._fed_total, self.replay.capacity)
+        cap = self.replay.capacity * (2 if self.replay_b is not None
+                                      else 1)
+        return min(self._fed_total, cap)
 
     # -- checkpoint: delegate to the attached HBM ring ---------------------
 
@@ -554,14 +591,14 @@ class DevicePerIngest(DeviceReplayIngest):
         self.importance_weight = importance_weight
         self.importance_anneal_steps = importance_anneal_steps
 
-    def attach(self, mesh: Optional[jax.sharding.Mesh] = None):
+    def _make_replay(self, capacity: int,
+                     mesh: Optional[jax.sharding.Mesh]):
         from pytorch_distributed_tpu.memory.device_per import DevicePerReplay
 
-        self.replay = DevicePerReplay(
-            self.capacity, self.state_shape, self.action_shape,
+        return DevicePerReplay(
+            capacity, self.state_shape, self.action_shape,
             self.state_dtype, self.action_dtype,
             priority_exponent=self.priority_exponent,
             importance_weight=self.importance_weight,
             importance_anneal_steps=self.importance_anneal_steps,
             mesh=mesh, channels_last=self.channels_last)
-        return self.replay

@@ -276,7 +276,8 @@ def init_rollout_carry(env, nstep: int) -> RolloutCarry:
 
 def build_fused_rollout(apply_fn: Callable, env, *, nstep: int,
                         gamma: float, rollout_ticks: int,
-                        emit: str = "chunk") -> Callable:
+                        emit: str = "chunk",
+                        ring_write_fn: Callable = None) -> Callable:
     """ONE donated on-device scan advancing N envs x K ticks: per tick,
     the policy forward, row-keyed eps-greedy action selection, the
     vectorized env step, and n-step transition assembly all run inside
@@ -312,6 +313,18 @@ def build_fused_rollout(apply_fn: Callable, env, *, nstep: int,
     carries ``q_sel``/``q_boot``/``prio_ok`` columns so the host can
     form the actor-side PER priority |R + gamma_n*maxQ(s_end) - q_sel|
     with two flops per row — same estimate, no device sync.
+
+    ``ring_write_fn`` (emit="replay" only) overrides the masked ring
+    scatter — the hook the co-located Anakin loop (agents/anakin.py)
+    uses to write into the HBM PER ring with new-row priority stamping
+    (memory/device_per.per_write_masked); None keeps the uniform-ring
+    ``ring_write_masked``.  The interleave contract for that loop: the
+    rollout program reads ``params`` but never writes them, and the
+    fused learner program reads the ring but only ever writes the
+    priority column — so alternating (or double-buffer-interleaving)
+    the two dispatches against the same device-resident state is
+    race-free by construction, and the acting params ARE the train
+    state's params (the published version is the acting version).
     """
     import jax
     import jax.numpy as jnp
@@ -331,6 +344,9 @@ def build_fused_rollout(apply_fn: Callable, env, *, nstep: int,
             ring_write_masked,
         )
         from pytorch_distributed_tpu.utils.experience import Transition
+
+        if ring_write_fn is None:
+            ring_write_fn = ring_write_masked
 
     def one_tick(params, eps, base_key, c: RolloutCarry, t):
         obs = env.observe(c.env_state)
@@ -458,7 +474,7 @@ def build_fused_rollout(apply_fn: Callable, env, *, nstep: int,
         def body(cs, t):
             c, ring, fed = cs
             c, e, (r, te, tr) = one_tick(params, eps, base_key, c, t)
-            ring, wrote = ring_write_masked(
+            ring, wrote = ring_write_fn(
                 ring, Transition(
                     state0=e["state0"], action=e["action"],
                     reward=e["reward"], gamma_n=e["gamma_n"],

@@ -31,8 +31,8 @@ from typing import Any, Dict, List, Optional
 
 from pytorch_distributed_tpu.config import Options
 from pytorch_distributed_tpu.factory import (
-    EnvSpec, build_memory, get_worker, needs_inference_server,
-    prebuild_native, probe_env,
+    EnvSpec, anakin_active, build_memory, get_worker,
+    needs_inference_server, prebuild_native, probe_env,
 )
 from pytorch_distributed_tpu.agents.clocks import (
     ActorStats, EvaluatorStats, GlobalClock, LearnerStats,
@@ -163,8 +163,13 @@ class Topology:
         if self.metrics_params.enabled:
             self.mission = telemetry.MissionControl(
                 opt.log_dir, self.metrics_params, opt.alert_params)
+        # anakin topology (ISSUE 12): NO actor workers exist — the env
+        # fleet lives in the learner process, so the watchdog board
+        # carries no actor slots and _worker_specs spawns none
+        self.anakin = anakin_active(opt)
         labels = ["learner", "evaluator-0"] + [
-            f"actor-{i}" for i in range(opt.num_actors)]
+            f"actor-{i}"
+            for i in range(0 if self.anakin else opt.num_actors)]
         self.progress_board = ProgressBoard(labels)
         self.clock.progress = self.progress_board
         self.hang_kills = 0  # watchdog SIGKILLs (health plane counter)
@@ -175,7 +180,7 @@ class Topology:
         opt, spec = self.opt, self.spec
         specs = [("logger", 0, (opt, self.clock, self.actor_stats,
                                 self.learner_stats, self.evaluator_stats))]
-        for i in range(opt.num_actors):
+        for i in range(0 if self.anakin else opt.num_actors):
             # per-actor feeder clone: thread workers must not share one
             # chunk buffer (process children get their own pickled copy)
             side = self.handles.actor_side
@@ -284,9 +289,24 @@ class Topology:
             self.mission.start()
         try:
             self.progress_board.note_start("learner")
-            run_learner = get_worker("learner", opt.agent_type)
-            run_learner(opt, self.spec, 0, self.handles.learner_side,
-                        self.param_store, self.clock, self.learner_stats)
+            if self.anakin:
+                # the co-located Anakin loop: this process hosts the
+                # env fleet AND the learner; pass the shared ActorStats
+                # so the logger's rollout curves keep flowing without
+                # any actor worker existing
+                from pytorch_distributed_tpu.agents.anakin import (
+                    run_anakin_learner,
+                )
+
+                run_anakin_learner(
+                    opt, self.spec, 0, self.handles.learner_side,
+                    self.param_store, self.clock, self.learner_stats,
+                    actor_stats=self.actor_stats)
+            else:
+                run_learner = get_worker("learner", opt.agent_type)
+                run_learner(opt, self.spec, 0, self.handles.learner_side,
+                            self.param_store, self.clock,
+                            self.learner_stats)
         finally:
             # learner done (or dead): release every spinning loop
             self.clock.stop.set()

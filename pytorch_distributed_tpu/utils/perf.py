@@ -483,24 +483,27 @@ class PerfMonitor:
             dt = now - anchor[0]
             d_up = self._updates - anchor[1]
             d_fr = self._frames - anchor[2]
+            # achieved FLOP/s SUMS the update- and frame-denominated
+            # programs: a monitor carrying both (the co-located Anakin
+            # loop, whose learner dispatches and rollout dispatches
+            # share one chip) reports the chip's total utilization, not
+            # whichever branch ran last
+            achieved = 0.0
             if self._updates or d_up:
                 ups = d_up / dt
                 out[f"{self.prefix}/updates_per_s"] = ups
                 if self.flops_per_update:
-                    achieved = ups * self.flops_per_update
-                    out[f"{self.prefix}/achieved_flops_per_s"] = achieved
-                    peak = self._peak_flops()
-                    if peak:
-                        out[f"{self.prefix}/mfu"] = achieved / peak
+                    achieved += ups * self.flops_per_update
             if self._frames or d_fr:
                 fps = d_fr / dt
                 out[f"{self.prefix}/env_frames_per_s"] = fps
                 if self.flops_per_frame:
-                    achieved = fps * self.flops_per_frame
-                    out[f"{self.prefix}/achieved_flops_per_s"] = achieved
-                    peak = self._peak_flops()
-                    if peak:
-                        out[f"{self.prefix}/mfu"] = achieved / peak
+                    achieved += fps * self.flops_per_frame
+            if achieved:
+                out[f"{self.prefix}/achieved_flops_per_s"] = achieved
+                peak = self._peak_flops()
+                if peak:
+                    out[f"{self.prefix}/mfu"] = achieved / peak
         if self.flops_per_update and not self._flops_reported:
             self._flops_reported = True
             out[f"{self.prefix}/flops_per_update"] = self.flops_per_update

@@ -431,6 +431,12 @@ DEFAULT_RULES = (
     "staleness_burn: data/staleness_p50 > 100 frac 0.5 over 300s",
     "priority_collapse: replay/priority_ess_frac < 0.02 for 120s",
     "overload_shed: flow/overload_state >= 2 for 120s",
+    # anakin duty cycle (ISSUE 12): a co-located loop whose rollout
+    # share collapses is starving the replay of fresh experience (the
+    # learner re-chews a frozen ring) — threshold-with-dwell so one
+    # checkpoint-heavy window never pages; non-anakin runs never
+    # report the tag, so the rule stays silently inert there
+    "rollout_starvation: anakin/duty_cycle < 0.02 for 120s",
 )
 
 
@@ -879,6 +885,7 @@ class MissionControl:
     KEY_TAGS = ("learner/updates_per_s", "learner/mfu",
                 "actor/env_frames_per_s", "data/staleness_p50",
                 "replay/priority_ess_frac", "flow/overload_state",
+                "anakin/duty_cycle", "anakin/replay_fill",
                 "learner/critic_loss", "evaluator/avg_reward",
                 "actor/avg_reward", "learner/steps_per_sec")
 

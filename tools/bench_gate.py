@@ -73,8 +73,12 @@ SPECS: List[Tuple[str, str, str]] = [
     ("device_env.device_frames_per_sec", "higher", "device_env"),
     ("device_env.fused_frames_per_sec", "higher", "device_env"),
     ("device_env.speedup_vs_host", "higher", "device_env"),
+    ("anakin.frames_per_sec", "higher", "anakin"),
+    ("anakin.updates_per_sec", "higher", "anakin"),
+    ("anakin.speedup_vs_device", "higher", "anakin"),
     ("smoke.updates_per_sec", "higher", "smoke"),
     ("smoke.device_env_frames_per_sec", "higher", "smoke"),
+    ("smoke.anakin_frames_per_sec", "higher", "smoke"),
 ]
 
 # Per-section default tolerance.  Relative for rates (sized to the
@@ -92,6 +96,10 @@ DEFAULT_TOL: Dict[str, float] = {
     # hosts; the speedup ratio divides out most machine noise but
     # keeps the same band for simplicity
     "device_env": 0.30,
+    # closed-loop pair rate + its split-process speedup (ISSUE 12):
+    # same dispatch-noise profile as device_env, and the split leg
+    # adds spawn-queue scheduling jitter on loaded hosts
+    "anakin": 0.30,
     "smoke": 0.40,      # CPU-host scheduling noise is large at small K
 }
 

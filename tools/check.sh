@@ -56,6 +56,9 @@ if [ "${APEXLINT_ONLY:-0}" = "1" ]; then
 fi
 
 echo "== stage 2: bench --smoke =="
+# covers the fused learner program, the ISSUE-7 device-env engine AND
+# the ISSUE-12 anakin closed-loop pair rate (smoke.anakin_frames_per_sec
+# gates vs the baseline in stage 3)
 if ! python bench.py --smoke > "$tmp/smoke.json"; then
     echo "bench --smoke: FAIL"
     exit 1

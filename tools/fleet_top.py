@@ -249,6 +249,31 @@ def actor_line(status: dict) -> Optional[str]:
     return f"  actors[{backend}]: " + " · ".join(bits)
 
 
+def anakin_line(status: dict) -> Optional[str]:
+    """One panel line for the ISSUE-12 co-located loop: the STATUS
+    ``anakin`` block (FleetTopology._health_snapshot) — duty cycle
+    (rollout share of busy time), rollout frames/s, ring fill and the
+    combined-MFU read.  Present only on anakin topologies; the
+    ``actors`` block is absent there by construction (no actor worker
+    exists), so this line replaces the actor panel."""
+    a = status.get("anakin")
+    if not a:
+        return None
+    bits = []
+    duty = a.get("duty_cycle")
+    bits.append(f"duty {duty:.0%}" if duty is not None else "duty ?")
+    fps = a.get("rollout_frames_per_s")
+    if fps is not None:
+        bits.append(f"rollout {fps:g} f/s")
+    fill = a.get("replay_fill")
+    if fill is not None:
+        bits.append(f"ring {fill:.0%}")
+    mfu = a.get("mfu")
+    if mfu is not None:
+        bits.append(f"mfu {mfu:.2%}")
+    return "  anakin: " + " · ".join(bits)
+
+
 def flow_line(status: dict) -> Optional[str]:
     """One panel line for the ISSUE-11 flow-control plane: the STATUS
     ``flow`` block (gateway GatewayFlow.status_block) — overload state
@@ -329,6 +354,9 @@ def render(status: dict,
     aline = actor_line(status)
     if aline:
         lines.append(aline)
+    kline = anakin_line(status)
+    if kline:
+        lines.append(kline)
     alline = alerts_line(status)
     if alline:
         lines.append(alline)
