@@ -108,8 +108,9 @@ class AnakinDriver:
 
         from pytorch_distributed_tpu.agents.clocks import ActorStats
         from pytorch_distributed_tpu.factory import (
-            anakin_eligible, build_device_env, build_model,
-            build_train_state_and_step, init_params,
+            anakin_eligible, build_device_env, build_megabatch_train_step,
+            build_model, build_train_state_and_step, init_params,
+            resolve_megabatch,
         )
         from pytorch_distributed_tpu.memory.device_per import (
             per_write_masked,
@@ -251,19 +252,37 @@ class AnakinDriver:
         K = ap.steps_per_dispatch
         if K <= 0:
             K = 32 if jax.devices()[0].platform == "tpu" else 1
+        # ISSUE-13 megabatching: the SAME factory resolution the
+        # split-process learner uses, so the co-located twin's learner
+        # dispatch is the same XLA program (the parity oracle's ground)
+        M, K_mb = resolve_megabatch(opt, K)
+        mega_step = None
+        if M > 1:
+            mega_step = build_megabatch_train_step(opt, self.model)
+            if mega_step is None:
+                print(f"[anakin] megabatch={M} unsupported for "
+                      f"agent_type={opt.agent_type}; sequential fused "
+                      f"step at steps_per_dispatch={K}", flush=True)
+                M = 1
+            else:
+                # only an ENGAGED megabatch inflates the dispatch
+                # quantum (and K_learn/duty-cycle accounting)
+                K = K_mb
+        mb_kw = (dict(megabatch=M, megabatch_step=mega_step)
+                 if M > 1 else {})
         self.K_learn = K
         self._beta = None
         if self.is_per:
             self._fused_per = self.rings[0].build_fused_step(
                 step_fn, ap.batch_size, donate=pp.donate,
-                steps_per_call=K)
+                steps_per_call=K, **mb_kw)
             self._fused = None
         else:
             self._fused_per = None
             if K > 1:
                 self._fused = build_uniform_fused_step(
                     step_fn, ap.batch_size, steps_per_call=K,
-                    donate=pp.donate)
+                    donate=pp.donate, **mb_kw)
             else:
                 self._fused = jax.jit(
                     lambda ts, rs, key: step_fn(
@@ -286,6 +305,10 @@ class AnakinDriver:
         # per-frame FLOPs (utils/perf.py drain combines them) ----
         self.perf = perf.get_monitor("learner", opt.perf_params)
         if self.perf.enabled:
+            # fp32 models score MFU against the fp32 peak (ISSUE 13)
+            _cd = getattr(self.model, "compute_dtype", None)
+            if _cd is not None:
+                self.perf.set_compute_dtype(jnp.dtype(_cd).name)
             self.perf.register_jit("fused_step",
                                    getattr(self._fused_per or self._fused,
                                            "_cache_size", None))

@@ -253,6 +253,26 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
     # runs through ShardedLearner, whose per-update FLOPs nobody
     # dispatch-amortizes)
     perf_mon = perf.get_monitor("learner", opt.perf_params)
+    if perf_mon.enabled:
+        # the MFU denominator scales by the dtype the model actually
+        # computes in (ISSUE-13 satellite: an fp32 run scored against
+        # the bf16 peak under-reports MFU 2x)
+        _cd = getattr(model, "compute_dtype", None)
+        if _cd is not None:
+            perf_mon.set_compute_dtype(jnp.dtype(_cd).name)
+    if not on_device:
+        # megabatch serves the fused device-replay dispatch only — a
+        # host-replay config with the knob set must say so LOUDLY (the
+        # same downgrade convention as the unsupported-family case
+        # below), not silently benchmark an unengaged lever
+        from pytorch_distributed_tpu.utils.perf import resolve_mxu
+
+        _m_req = resolve_mxu(opt.learner_perf_params).megabatch
+        if _m_req > 1:
+            print(f"[learner] megabatch={_m_req} requires a device "
+                  f"replay (memory_type device/device-per; got "
+                  f"{opt.memory_type}); host-path learner runs "
+                  f"unbatched", flush=True)
     if on_device:
         # Attach the HBM ring on the learner's mesh and fuse sampling (and
         # for PER: priority write-back) into the train step — one XLA
@@ -270,10 +290,35 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             # small while recovering most of the win (bench.py micro,
             # 2026-07-31)
             K = 32 if jax.devices()[0].platform == "tpu" else 1
+        # ISSUE-13 megabatching: group the K scanned updates into K/M
+        # widened-gather groups (one lane-filling batched backward per
+        # group); the group step comes from the factory so the
+        # sequential and megabatch paths share torso/optimizer gates
+        from pytorch_distributed_tpu.factory import (
+            build_megabatch_train_step, resolve_megabatch,
+        )
+
+        M, K_mb = resolve_megabatch(opt, K)
+        mega_step = None
+        if M > 1:
+            mega_step = build_megabatch_train_step(opt, model)
+            if mega_step is None:
+                print(f"[learner] megabatch={M} is not supported for "
+                      f"agent_type={opt.agent_type} (dqn/decoupled-ddpg "
+                      f"only); running the sequential fused step at "
+                      f"steps_per_dispatch={K}", flush=True)
+                M = 1
+            else:
+                # only an ENGAGED megabatch inflates the dispatch
+                # quantum — a downgrade keeps the configured K
+                K = K_mb
+        mb_kw = (dict(megabatch=M, megabatch_step=mega_step)
+                 if M > 1 else {})
         if is_device_per:
             fused_per = replay.build_fused_step(step_fn, ap.batch_size,
                                                 donate=pp.donate,
-                                                steps_per_call=K)
+                                                steps_per_call=K,
+                                                **mb_kw)
 
             def device_step(keys):
                 nonlocal state
@@ -288,7 +333,7 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
             if K > 1:
                 fused = build_uniform_fused_step(
                     step_fn, ap.batch_size, steps_per_call=K,
-                    donate=pp.donate)
+                    donate=pp.donate, **mb_kw)
 
                 def device_step(keys):
                     nonlocal state

@@ -55,6 +55,7 @@ CONFIGS = [
     ["ddpg",      "classic",   "reacher",     "shared",      "ddpg-mlp"],# 16 multi-dim continuous control
     ["r2d2",      "fake",      "chain",       "sequence",    "dtqn-moe"],# 17 MoE transformer Q (expert parallel)
     ["r2d2",      "fake",      "chain",       "sequence",    "dtqn-pipe"],# 18 staged transformer Q (pipeline parallel)
+    ["dqn",       "pong-sim",  "pong",        "device-per",  "dqn-cnn-wide"],# 19 MXU-filling wide torso (ISSUE 13)
 ]
 
 
@@ -112,6 +113,9 @@ KNOBS = (
     ("TPU_APEX_ANAKIN_*", "agents/anakin.py",
      "per-field AnakinParams overrides (e.g. TPU_APEX_ANAKIN_ROLLOUT_RATIO, "
      "TPU_APEX_ANAKIN_DOUBLE_BUFFER)"),
+    ("TPU_APEX_MXU_*", "utils/perf.py",
+     "per-field LearnerPerfParams overrides — the ISSUE-13 MFU-campaign "
+     "levers (e.g. TPU_APEX_MXU_MEGABATCH, TPU_APEX_MXU_PALLAS_TORSO)"),
 )
 
 
@@ -249,6 +253,10 @@ class ModelParams:
     tf_dim: int = 128
     tf_heads: int = 4
     tf_depth: int = 2
+    # dqn-cnn-wide (ISSUE 13): base channel width of the MXU-filling
+    # IMPALA-deep torso — multiples of 128 fill the 128-lane MXU the
+    # Nature CNN's 4/32/64 channels underfill (models/dqn_cnn_wide.py)
+    cnn_wide_width: int = 128
     # MoE (dtqn-moe) routing: expert count, choices per token, per-row
     # slot headroom, and the Switch load-balancing loss weight
     # (models/moe.py)
@@ -652,6 +660,47 @@ class AnakinParams:
 
 
 @dataclass
+class LearnerPerfParams:
+    """MFU-campaign knobs (ISSUE 13; no reference equivalent — the
+    reference never measures device utilization at all).  Every field
+    is env-overridable as ``TPU_APEX_MXU_<FIELD>`` via
+    ``utils/perf.resolve_mxu``, the same spawn-inheritance contract the
+    health/perf/flow planes use.  All three levers are OPT-IN: the
+    defaults reproduce the pre-campaign learner bit-for-bit."""
+
+    # Megabatch factor M for the fused device-replay learner step (dqn
+    # and ddpg flat families): each scan group samples M minibatches in
+    # ONE widened gather (consuming the SAME M keys the sequential
+    # schedule would) and computes all M per-minibatch gradients in one
+    # lane-filling (M*B, ...) batched forward/backward at the
+    # group-entry params, then applies the M optimizer updates
+    # SEQUENTIALLY in-graph (Adam moments, step counter, target-update
+    # cadence and PER |TD| write-backs chain exactly as M separate
+    # steps).  The one semantic divergence from M sequential steps is
+    # within-group gradient freshness — gradients see the group-entry
+    # params instead of the per-step params — the large-effective-batch
+    # trade Stooke & Abbeel (2018) validate for the DQN family; the
+    # tier-1 oracle (tests/test_megabatch.py) pins the program
+    # bit-exactly against an unfused reference of the same semantics.
+    # 1 = off (the pre-campaign program); must divide
+    # ``steps_per_dispatch``.
+    megabatch: int = 1
+    # Pallas fused conv-stack/Q-head torso for dqn-cnn
+    # (ops/pallas_torso.py): the learner's train apply runs the torso
+    # as hand-tiled 128-lane MXU matmul kernels (im2col) instead of
+    # XLA's conv lowering, bypassing the ~25% of device time
+    # mfu_probe.py attributes to XLA re-tiling.  Loud downgrade to the
+    # XLA apply when Pallas/TPU is unavailable (unless
+    # ``pallas_interpret``).  Actors/evaluators keep the standard
+    # apply — the param tree is identical.
+    pallas_torso: bool = False
+    # Run the Pallas torso kernels in interpreter mode (CPU hosts):
+    # the tier-1 parity tests use this; production CPU runs should
+    # leave it off (interpret mode is slower than XLA's native conv).
+    pallas_interpret: bool = False
+
+
+@dataclass
 class ParallelParams:
     """TPU topology knobs — no reference equivalent (the reference is a
     single-node torch.multiprocessing program, SURVEY.md §2); this is where
@@ -729,6 +778,8 @@ class Options:
     alert_params: AlertParams = field(default_factory=AlertParams)
     flow_params: FlowParams = field(default_factory=FlowParams)
     anakin_params: AnakinParams = field(default_factory=AnakinParams)
+    learner_perf_params: LearnerPerfParams = field(
+        default_factory=LearnerPerfParams)
 
     @property
     def model_dir(self) -> str:
@@ -822,7 +873,8 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
         for sub in ("env_params", "memory_params", "model_params",
                     "agent_params", "parallel_params", "health_params",
                     "perf_params", "metrics_params", "alert_params",
-                    "flow_params", "anakin_params"):
+                    "flow_params", "anakin_params",
+                    "learner_perf_params"):
             subobj = getattr(opt, sub)
             if hasattr(subobj, key):
                 hits.append((sub, subobj))

@@ -58,8 +58,9 @@ MemoriesDict: Dict[str, Optional[Callable]] = {
 }
 
 # model ctors bound in build_model below (they need probed shapes)
-ModelTypes = ("dqn-cnn", "dqn-mlp", "ddpg-mlp", "drqn-mlp", "drqn-cnn",
-              "dtqn-mlp", "dtqn-moe", "dtqn-pipe")
+ModelTypes = ("dqn-cnn", "dqn-cnn-wide", "dqn-mlp", "ddpg-mlp",
+              "drqn-mlp", "drqn-cnn", "dtqn-mlp", "dtqn-moe",
+              "dtqn-pipe")
 
 
 def _worker_dicts():
@@ -413,6 +414,19 @@ def build_model(opt: Options, spec: EnvSpec):
             orthogonal_init=mp_.orthogonal_init,
             compute_dtype=jnp.dtype(mp_.compute_dtype),
         )
+    if opt.model_type == "dqn-cnn-wide":
+        # the MXU-filling torso family (ISSUE 13): IMPALA-deep residual
+        # stack with 128-multiple channel widths (models/dqn_cnn_wide.py)
+        from pytorch_distributed_tpu.models.dqn_cnn_wide import (
+            DqnCnnWideModel,
+        )
+
+        return DqnCnnWideModel(
+            action_space=spec.num_actions,
+            norm_val=spec.norm_val,
+            width=mp_.cnn_wide_width,
+            compute_dtype=jnp.dtype(mp_.compute_dtype),
+        )
     if opt.model_type == "dqn-mlp":
         return DqnMlpModel(
             action_space=spec.num_actions,
@@ -623,12 +637,7 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
         tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay,
                             lr_decay_steps=decay)
         state = init_train_state(params, tx)
-        train_apply = model.apply
-        if device_ring_channels_last(opt):
-            # the HBM ring stores rows NHWC (same param tree, transpose
-            # moved from 3x per update to once per ingest — see
-            # memory/device_replay.py chunk_to_nhwc)
-            train_apply = model.clone(nhwc_input=True).apply
+        train_apply = _dqn_train_apply(opt, model)
         step = build_dqn_train_step(
             train_apply, tx,
             enable_double=ap.enable_double,
@@ -660,6 +669,125 @@ def build_train_state_and_step(opt: Options, spec: EnvSpec, model, params,
         return state, step
 
     raise ValueError(f"unknown agent_type: {opt.agent_type}")
+
+
+def _dqn_train_apply(opt: Options, model):
+    """The learner-side apply for the dqn family: the model's own apply,
+    re-based for NHWC ring storage when that knob is live, and swapped
+    for the Pallas fused torso (ops/pallas_torso.py) when the ISSUE-13
+    ``pallas_torso`` knob is on and runnable.  Decided HERE — one gate
+    shared by the sequential step and the megabatch step — so the two
+    programs can never train through different torsos.  Actors and
+    evaluators never route through this: the param tree is identical,
+    so they keep the standard apply."""
+    train_apply = model.apply
+    nhwc = device_ring_channels_last(opt)
+    if nhwc:
+        # the HBM ring stores rows NHWC (same param tree, transpose
+        # moved from 3x per update to once per ingest — see
+        # memory/device_replay.py chunk_to_nhwc)
+        train_apply = model.clone(nhwc_input=True).apply
+    from pytorch_distributed_tpu.utils.perf import resolve_mxu
+
+    lp = resolve_mxu(opt.learner_perf_params)
+    if not lp.pallas_torso:
+        return train_apply
+    import warnings
+
+    if opt.model_type != "dqn-cnn":
+        warnings.warn(
+            f"pallas_torso=true serves the dqn-cnn torso only (got "
+            f"model_type={opt.model_type}); keeping the XLA apply",
+            stacklevel=3)
+        return train_apply
+    import jax
+
+    if jax.devices()[0].platform != "tpu" and not lp.pallas_interpret:
+        # LOUD downgrade, never a silent one: a config that asked for
+        # the MXU kernel but runs on a host without one must say so
+        warnings.warn(
+            "pallas_torso=true but no TPU backend is present "
+            "(set pallas_interpret=true for the interpreter-mode CPU "
+            "fallback — tier-1 parity tests only; it is slower than "
+            "XLA's native conv); keeping the XLA apply", stacklevel=3)
+        return train_apply
+    from pytorch_distributed_tpu.ops.pallas_torso import (
+        build_pallas_torso_apply,
+    )
+    import jax.numpy as jnp
+
+    return build_pallas_torso_apply(
+        norm_val=model.norm_val,
+        compute_dtype=jnp.dtype(opt.model_params.compute_dtype),
+        nhwc_input=nhwc,
+        interpret=lp.pallas_interpret)
+
+
+def build_megabatch_train_step(opt: Options, model):
+    """The ISSUE-13 megabatch twin of ``build_train_state_and_step``'s
+    step: a ``(TrainState, batches(M, B)) -> (TrainState, metrics,
+    td_abs(M, B), ok(M,))`` group step computing all M minibatch
+    gradients in one lane-filling batched backward with sequential
+    in-graph optimizer applies (ops/losses.py megabatch builders).
+
+    The optimizer chain is constructed EXACTLY as the sequential
+    builder constructs it, so the TrainState the sequential path
+    initialised (and checkpointed) is directly consumable.  Returns
+    None for families without megabatch support (the sequence/
+    transformer families and coupled DDPG) — callers downgrade loudly.
+    No mesh parameter on purpose: the supported families' data
+    parallelism is SPMD through jit sharding (the sequential builder
+    only consumes its mesh for the sequence-parallel DTQN paths, which
+    megabatch does not serve).
+    """
+    from pytorch_distributed_tpu.ops.losses import (
+        build_ddpg_megabatch_step, build_dqn_megabatch_step,
+        make_optimizer,
+    )
+    from pytorch_distributed_tpu.utils import health
+
+    ap = opt.agent_params
+    decay = ap.steps if ap.lr_decay else 0
+    guard = health.resolve(opt.health_params).numeric_guards
+    if opt.agent_type == "dqn":
+        tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay,
+                            lr_decay_steps=decay)
+        return build_dqn_megabatch_step(
+            _dqn_train_apply(opt, model), tx,
+            enable_double=ap.enable_double,
+            target_model_update=ap.target_model_update,
+            guard=guard,
+        )
+    if opt.agent_type == "ddpg" and not ap.ddpg_coupled_update:
+        actor_apply, critic_apply = ddpg_applies(model)
+        atx = make_optimizer(ap.lr, ap.clip_grad, lr_decay_steps=decay)
+        ctx_ = make_optimizer(ap.critic_lr, ap.clip_grad,
+                              lr_decay_steps=decay)
+        return build_ddpg_megabatch_step(
+            actor_apply, critic_apply, atx, ctx_,
+            target_model_update=ap.target_model_update,
+            guard=guard,
+        )
+    return None
+
+
+def resolve_megabatch(opt: Options, steps_per_call: int
+                      ) -> Tuple[int, int]:
+    """Resolve the ISSUE-13 megabatch knob against a dispatch's
+    ``steps_per_call``: returns ``(M, K)`` with M clamped to >= 1 and K
+    rounded UP to the next multiple of M (the ``steps`` budget already
+    tolerates whole-dispatch overshoot; silently truncating updates
+    would be worse).  One resolution point shared by the learner and
+    its Anakin twin so the two can never disagree on grouping."""
+    from pytorch_distributed_tpu.utils.perf import resolve_mxu
+
+    M = max(1, int(resolve_mxu(opt.learner_perf_params).megabatch))
+    K = max(1, int(steps_per_call))
+    if M > 1 and K % M:
+        K = ((K + M - 1) // M) * M
+        print(f"[learner] steps_per_dispatch rounded up to {K} "
+              f"(multiple of megabatch {M})", flush=True)
+    return M, K
 
 
 def published_params(opt: Options, state) -> Any:

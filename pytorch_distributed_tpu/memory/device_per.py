@@ -229,19 +229,72 @@ class DevicePerReplay(DeviceReplay):
         return self.beta0 + (1.0 - self.beta0) * frac
 
     def build_fused_step(self, train_step, batch_size: int,
-                         donate: bool = True, steps_per_call: int = 1):
+                         donate: bool = True, steps_per_call: int = 1,
+                         megabatch: int = 1, megabatch_step=None):
         """Fused sample -> train -> priority write-back; ``steps_per_call``
         sub-steps scan inside one XLA program (keys then shaped (K, 2)),
         amortising dispatch latency like
         device_replay.build_uniform_fused_step — with the priority state
         chained through the scan so each sub-step samples from the
-        previous one's updated priorities."""
+        previous one's updated priorities.
+
+        ``megabatch`` M > 1 (ISSUE 13, with ``megabatch_step`` from
+        factory.build_megabatch_train_step) regroups the K sub-steps
+        into K/M groups: one WIDENED PER gather draws all M minibatches
+        of a group from the GROUP-ENTRY priorities (consuming the same
+        M keys the sequential schedule would — within-group priority
+        freshness is the documented megabatch trade; groups still chain
+        through each other's write-backs), one lane-filling batched
+        forward/backward computes the M gradients, and the M |TD|
+        write-backs land sequentially in minibatch order so index
+        collisions resolve exactly as M sequential steps — skipped
+        (guarded) minibatches suppressed per row."""
         alpha = self.alpha
         draw_fn = self._draw_fn
 
         from pytorch_distributed_tpu.utils.health import (
             SKIPPED_KEY, reduce_scan_metrics, suppress_writeback,
         )
+
+        if megabatch > 1:
+            assert megabatch_step is not None, \
+                "megabatch > 1 needs the factory's megabatch step"
+            assert steps_per_call % megabatch == 0, (
+                f"megabatch {megabatch} must divide steps_per_call "
+                f"{steps_per_call}")
+            groups = steps_per_call // megabatch
+
+            def one_group(ts, rs: PerReplayState, kset, beta):
+                batches = jax.vmap(
+                    lambda k: per_sample(rs, k, batch_size, beta,
+                                         sample_fn=draw_fn))(kset)
+                ts, metrics, td_abs, ok = megabatch_step(ts, batches)
+
+                def writeback(rs_c, x):
+                    idx, td, ok_i = x
+                    rs_new = per_update_priorities(rs_c, idx, td, alpha)
+                    # suppress_writeback takes the SKIPPED flag (1.0 =
+                    # skipped); ok is the validity mask
+                    return suppress_writeback(1.0 - ok_i, rs_new,
+                                              rs_c), None
+
+                rs, _ = jax.lax.scan(writeback, rs,
+                                     (batches.index, td_abs, ok))
+                return ts, rs, metrics
+
+            def multi_mega(ts, rs, keys, beta):
+                gkeys = keys.reshape(groups, megabatch, *keys.shape[1:])
+
+                def body(carry, kset):
+                    ts, rs = carry
+                    ts, rs, metrics = one_group(ts, rs, kset, beta)
+                    return (ts, rs), metrics
+
+                (ts, rs), metrics = jax.lax.scan(body, (ts, rs), gkeys)
+                return ts, rs, reduce_scan_metrics(metrics)
+
+            return jax.jit(multi_mega,
+                           donate_argnums=(0, 1) if donate else ())
 
         def one(ts, rs: PerReplayState, key, beta):
             batch = per_sample(rs, key, batch_size, beta, sample_fn=draw_fn)

@@ -200,7 +200,8 @@ def provenance_sample(state: ReplayState, key: jax.Array,
 
 
 def build_uniform_fused_step(step_fn, batch_size: int,
-                             steps_per_call: int = 1, donate: bool = True):
+                             steps_per_call: int = 1, donate: bool = True,
+                             megabatch: int = 1, megabatch_step=None):
     """One XLA program running ``steps_per_call`` sample+train steps over
     the HBM ring: ``(train_state, ring_state, keys (K, 2)) ->
     (train_state', metrics_of_last_substep)``.
@@ -210,7 +211,41 @@ def build_uniform_fused_step(step_fn, batch_size: int,
     tunnel (or any high-latency dispatch path): K updates per dispatch
     amortise the launch to 1/K per update.  The ring is read-only inside —
     ingest stays on the host drain cadence between dispatches.
+
+    ``megabatch`` M > 1 (ISSUE 13, with ``megabatch_step`` from
+    factory.build_megabatch_train_step) regroups the K scanned steps
+    into K/M groups: each group samples its M minibatches in one
+    WIDENED gather — consuming exactly the keys the sequential schedule
+    would (key g*M+i draws minibatch i of group g, bit-identical index
+    streams) — and runs them as one lane-filling (M*B, ...) batched
+    forward/backward with sequential in-graph optimizer applies
+    (ops/losses.build_dqn_megabatch_step).  Dispatch count is
+    unchanged; per-update op count drops ~M-fold, which is the whole
+    win on dispatch-bound families.
     """
+    from pytorch_distributed_tpu.utils.health import reduce_scan_metrics
+
+    if megabatch > 1:
+        assert megabatch_step is not None, \
+            "megabatch > 1 needs the factory's megabatch step"
+        assert steps_per_call % megabatch == 0, (
+            f"megabatch {megabatch} must divide steps_per_call "
+            f"{steps_per_call}")
+        groups = steps_per_call // megabatch
+
+        def multi_mega(ts, ring_state, keys):
+            gkeys = keys.reshape(groups, megabatch, *keys.shape[1:])
+
+            def one_group(ts, kset):
+                batches = jax.vmap(
+                    lambda k: sample_rows(ring_state, k, batch_size))(kset)
+                ts, metrics, _td, _ok = megabatch_step(ts, batches)
+                return ts, metrics
+
+            ts, metrics = jax.lax.scan(one_group, ts, gkeys)
+            return ts, reduce_scan_metrics(metrics)
+
+        return jax.jit(multi_mega, donate_argnums=(0,) if donate else ())
 
     def multi(ts, ring_state, keys):
         def one(ts, key):
@@ -222,10 +257,6 @@ def build_uniform_fused_step(step_fn, batch_size: int,
         # last substep's metrics stand in for the dispatch, EXCEPT the
         # guard's skip counter, which sums over the scan
         # (utils/health.py reduce_scan_metrics)
-        from pytorch_distributed_tpu.utils.health import (
-            reduce_scan_metrics,
-        )
-
         return ts, reduce_scan_metrics(metrics)
 
     return jax.jit(multi, donate_argnums=(0,) if donate else ())

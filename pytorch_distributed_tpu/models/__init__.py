@@ -1,4 +1,5 @@
 from pytorch_distributed_tpu.models.dqn_cnn import DqnCnnModel
+from pytorch_distributed_tpu.models.dqn_cnn_wide import DqnCnnWideModel
 from pytorch_distributed_tpu.models.dqn_mlp import DqnMlpModel
 from pytorch_distributed_tpu.models.ddpg_mlp import DdpgMlpModel
 from pytorch_distributed_tpu.models.policies import (
@@ -7,7 +8,7 @@ from pytorch_distributed_tpu.models.policies import (
 )
 
 __all__ = [
-    "DqnCnnModel", "DqnMlpModel", "DdpgMlpModel",
+    "DqnCnnModel", "DqnCnnWideModel", "DqnMlpModel", "DdpgMlpModel",
     "build_epsilon_greedy_act", "build_ddpg_act", "apex_epsilon",
     "build_packed_act", "build_recurrent_packed_act",
 ]

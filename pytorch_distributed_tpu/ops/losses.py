@@ -150,6 +150,248 @@ def build_dqn_train_step(
     return finite_guard(step) if guard else step
 
 
+def _per_minibatch_ok(*arrays, grads=None):
+    """(M,) float32 validity mask over a megabatch group: 1.0 where every
+    per-minibatch quantity (loss/td rows, every grad leaf) is finite —
+    the per-minibatch twin of ``finite_guard``'s whole-step check, so a
+    poisoned minibatch skips ITS update without discarding the group's
+    other M-1 updates."""
+    ok = None
+    for a in arrays:
+        flat = a.reshape(a.shape[0], -1) if a.ndim > 1 else a[:, None]
+        this = jnp.all(jnp.isfinite(flat), axis=1)
+        ok = this if ok is None else ok & this
+    if grads is not None:
+        for leaf in jax.tree_util.tree_leaves(grads):
+            this = jnp.all(jnp.isfinite(leaf.reshape(leaf.shape[0], -1)),
+                           axis=1)
+            ok = this if ok is None else ok & this
+    return ok.astype(jnp.float32)
+
+
+def build_dqn_megabatch_step(
+    apply_fn: Callable,
+    tx: optax.GradientTransformation,
+    *,
+    enable_double: bool = False,
+    target_model_update: float = 250,
+    huber: bool = False,
+    axis_name: str | None = None,
+    guard: bool = True,
+) -> Callable:
+    """ISSUE-13 megabatch group step: ``(state, batches) -> (state,
+    metrics, td_abs, ok)`` where ``batches`` carries M minibatches as
+    (M, B)-leading leaves.
+
+    All M per-minibatch gradients are computed at the GROUP-ENTRY
+    params in ONE batched forward/backward (``jax.vmap`` over the
+    minibatch axis — XLA sees (M*B)-row lane-filling GEMMs instead of M
+    dispatch-bound small ones), then the M optimizer updates apply
+    SEQUENTIALLY in-graph: Adam moments, the step counter and the
+    target-update cadence chain exactly as M separate
+    ``build_dqn_train_step`` calls would.  The one divergence from M
+    sequential steps is within-group gradient freshness (gradients see
+    the group-entry params, the Stooke & Abbeel 2018 large-effective-
+    batch trade); with M=1 the program is the sequential step's exact
+    semantics.  The tier-1 oracle (tests/test_megabatch.py) pins the
+    program against an unfused reference of these semantics.
+
+    ``guard`` applies the finite check PER MINIBATCH: a non-finite
+    minibatch skips its own update (params/opt/target/step pass
+    through), its td_abs row is zeroed, and ``metrics[SKIPPED_KEY]``
+    counts the group's skips; ``ok`` (M,) float lets the PER write-back
+    suppress exactly the skipped rows."""
+    from pytorch_distributed_tpu.utils.health import SKIPPED_KEY
+
+    def minibatch_loss(params, target_params, batch: Batch):
+        q = apply_fn(params, batch.state0)                       # (B, A)
+        a = batch.action.astype(jnp.int32).reshape(-1, 1)
+        q_sel = jnp.take_along_axis(q, a, axis=1)[:, 0]
+        q_next = apply_fn(target_params, batch.state1)           # (B, A)
+        if enable_double:
+            a_next = jnp.argmax(apply_fn(params, batch.state1), axis=-1)
+            bootstrap = jnp.take_along_axis(
+                q_next, a_next[:, None], axis=1)[:, 0]
+        else:
+            bootstrap = jnp.max(q_next, axis=-1)
+        target = (batch.reward
+                  + batch.gamma_n * bootstrap * (1.0 - batch.terminal1))
+        loss, td_abs = _value_loss(q_sel, target, batch.weight, huber)
+        return loss, (td_abs, jnp.mean(jnp.max(q, axis=-1)))
+
+    def step(state: TrainState, batches: Batch):
+        grad_fn = jax.value_and_grad(minibatch_loss, has_aux=True)
+        (losses, (td_abs, q_means)), grads = jax.vmap(
+            grad_fn, in_axes=(None, None, 0))(
+                state.params, state.target_params, batches)
+        grads = _pmean(grads, axis_name)
+        M = losses.shape[0]
+        ok = (_per_minibatch_ok(losses, td_abs, q_means, grads=grads)
+              if guard else jnp.ones((M,), jnp.float32))
+
+        def apply_one(carry, x):
+            params, opt_state, target_params, step_c = carry
+            g, ok_i = x
+            updates, new_opt = tx.update(g, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            new_step = step_c + 1
+            new_target = update_target(target_params, new_params,
+                                       new_step, target_model_update)
+            keep = ok_i > 0.5
+            sel = lambda n, o: jax.tree_util.tree_map(
+                lambda a, b: jnp.where(keep, a, b), n, o)
+            return (sel(new_params, params), sel(new_opt, opt_state),
+                    sel(new_target, target_params),
+                    jnp.where(keep, new_step, step_c)), None
+
+        (params, opt_state, target_params, new_step), _ = jax.lax.scan(
+            apply_one,
+            (state.params, state.opt_state, state.target_params,
+             state.step),
+            (grads, ok))
+        last_grad = jax.tree_util.tree_map(lambda l: l[-1], grads)
+        metrics = {
+            "learner/critic_loss": losses[-1],
+            "learner/q_mean": q_means[-1],
+            "learner/grad_norm": global_norm(last_grad),
+        }
+        if guard:
+            metrics[SKIPPED_KEY] = jnp.sum(1.0 - ok)
+        td_abs = jnp.where(ok[:, None] > 0.5, td_abs,
+                           jnp.zeros_like(td_abs))
+        return (TrainState(params, target_params, opt_state, new_step),
+                metrics, td_abs, ok)
+
+    return step
+
+
+def build_ddpg_megabatch_step(
+    actor_apply_fn: Callable,
+    critic_apply_fn: Callable,
+    actor_tx: optax.GradientTransformation,
+    critic_tx: optax.GradientTransformation,
+    *,
+    target_model_update: float = 1e-3,
+    huber: bool = False,
+    axis_name: str | None = None,
+    guard: bool = True,
+) -> Callable:
+    """Decoupled-DDPG twin of ``build_dqn_megabatch_step``: same
+    ``(state, batches(M, B)) -> (state, metrics, td_abs, ok)`` group
+    contract.
+
+    Group semantics (tests/test_megabatch.py pins the unfused
+    reference): all M critic gradients batched at the group-entry
+    params; the M critic updates apply sequentially; all M actor
+    gradients batched at (group-entry actor, the FINAL post-group
+    critic) — for M=1 this is exactly ``build_ddpg_train_step``'s
+    "actor sees the freshly-updated critic"; the M actor updates apply
+    sequentially and the soft target update chains per minibatch with
+    the per-step (actor_i, critic_i) pair.
+
+    Guard semantics (per minibatch, documented divergence from the
+    whole-step ``finite_guard``): the critic-stage mask (critic
+    loss/td/grads finite) gates the critic chain; the COMBINED mask
+    (critic & actor stages) gates the actor/target/step chain, zeroes
+    td_abs rows and is the returned ``ok`` — so a minibatch whose
+    actor stage alone is non-finite keeps its (finite) critic update.
+    """
+    from pytorch_distributed_tpu.utils.health import SKIPPED_KEY
+
+    def critic_loss_fn(critic_params, actor_params, target_full,
+                       batch: Batch):
+        full = merge_ddpg_params(actor_params, critic_params)
+        q = critic_apply_fn(full, batch.state0, batch.action)
+        a_next = actor_apply_fn(target_full, batch.state1)
+        q_next = critic_apply_fn(target_full, batch.state1, a_next)
+        tgt = (batch.reward
+               + batch.gamma_n * q_next * (1.0 - batch.terminal1))
+        return _value_loss(q, tgt, batch.weight, huber)
+
+    def actor_loss_fn(actor_params, critic_params, batch: Batch):
+        full = merge_ddpg_params(actor_params, critic_params)
+        a = actor_apply_fn(full, batch.state0)
+        return -jnp.mean(critic_apply_fn(full, batch.state0, a))
+
+    def step(state: TrainState, batches: Batch):
+        params, target = state.params, state.target_params
+        target_full = merge_ddpg_params(target["actor"], target["critic"])
+
+        # ---- stage 1: M critic grads at group entry, one batched bwd ----
+        cgrad_fn = jax.value_and_grad(critic_loss_fn, has_aux=True)
+        (closs, td_abs), cgrads = jax.vmap(
+            cgrad_fn, in_axes=(None, None, None, 0))(
+                params["critic"], params["actor"], target_full, batches)
+        cgrads = _pmean(cgrads, axis_name)
+        M = closs.shape[0]
+        ones = jnp.ones((M,), jnp.float32)
+        ok_c = (_per_minibatch_ok(closs, td_abs, grads=cgrads)
+                if guard else ones)
+
+        def capply(carry, x):
+            cp, copt = carry
+            g, ok_i = x
+            updates, new_opt = critic_tx.update(g, copt, cp)
+            new_cp = optax.apply_updates(cp, updates)
+            keep = ok_i > 0.5
+            sel = lambda n, o: jax.tree_util.tree_map(
+                lambda a, b: jnp.where(keep, a, b), n, o)
+            new_cp = sel(new_cp, cp)
+            return (new_cp, sel(new_opt, copt)), new_cp
+
+        (final_critic, critic_opt), critics = jax.lax.scan(
+            capply, (params["critic"], state.opt_state["critic"]),
+            (cgrads, ok_c))
+
+        # ---- stage 2: M actor grads at (entry actor, final critic) ----
+        agrad_fn = jax.value_and_grad(actor_loss_fn)
+        aloss, agrads = jax.vmap(agrad_fn, in_axes=(None, None, 0))(
+            params["actor"], final_critic, batches)
+        agrads = _pmean(agrads, axis_name)
+        ok = ok_c * (_per_minibatch_ok(aloss, grads=agrads)
+                     if guard else ones)
+
+        def aapply(carry, x):
+            ap_, aopt, tgt, step_c = carry
+            g, ok_i, critic_i = x
+            updates, new_opt = actor_tx.update(g, aopt, ap_)
+            new_ap = optax.apply_updates(ap_, updates)
+            new_step = step_c + 1
+            new_tgt = update_target(
+                tgt, {"actor": new_ap, "critic": critic_i}, new_step,
+                target_model_update)
+            keep = ok_i > 0.5
+            sel = lambda n, o: jax.tree_util.tree_map(
+                lambda a, b: jnp.where(keep, a, b), n, o)
+            return (sel(new_ap, ap_), sel(new_opt, aopt),
+                    sel(new_tgt, tgt),
+                    jnp.where(keep, new_step, step_c)), None
+
+        (final_actor, actor_opt, new_target, new_step), _ = jax.lax.scan(
+            aapply,
+            (params["actor"], state.opt_state["actor"], target,
+             state.step),
+            (agrads, ok, critics))
+
+        last_g = jax.tree_util.tree_map(
+            lambda l: l[-1], {"actor": agrads, "critic": cgrads})
+        metrics = {
+            "learner/critic_loss": closs[-1],
+            "learner/actor_loss": aloss[-1],
+            "learner/grad_norm": global_norm(last_g),
+        }
+        if guard:
+            metrics[SKIPPED_KEY] = jnp.sum(1.0 - ok)
+        td_abs = jnp.where(ok[:, None] > 0.5, td_abs,
+                           jnp.zeros_like(td_abs))
+        new_state = TrainState(
+            {"actor": final_actor, "critic": final_critic}, new_target,
+            {"actor": actor_opt, "critic": critic_opt}, new_step)
+        return new_state, metrics, td_abs, ok
+
+    return step
+
+
 def init_ddpg_train_state(
     full_params: PyTree,
     actor_tx: optax.GradientTransformation,

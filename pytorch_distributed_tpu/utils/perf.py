@@ -76,13 +76,29 @@ PEAK_FLOPS = {
     "TPU v6 lite": 918e12,
 }
 
+# Peak scaling per compute dtype relative to the bf16 table above: the
+# MXU runs fp32 matmuls at half the bf16 rate (two passes), so an fp32
+# run scored against the bf16 peak under-reports MFU by 2x (ISSUE-13
+# satellite: config.compute_dtype admits fp32, and a denominator that
+# ignores it makes the fp32 lever in mfu_probe.py look like an MFU
+# collapse instead of the same chip at its fp32 peak).
+DTYPE_PEAK_SCALE = {
+    "bfloat16": 1.0,
+    "float32": 0.5,
+}
 
-def peak_flops_of(device) -> Optional[float]:
+
+def peak_flops_of(device, compute_dtype: Optional[str] = None
+                  ) -> Optional[float]:
     """Peak dense FLOP/s for a jax device, None when the kind is not in
-    the table (CPU, future generations)."""
+    the table (CPU, future generations).  ``compute_dtype`` scales the
+    bf16 table entry to the dtype's MXU peak (fp32 = half); unknown
+    dtypes keep the bf16 figure."""
     kind = getattr(device, "device_kind", "") or ""
     for name, peak in PEAK_FLOPS.items():
         if kind.lower().startswith(name.lower()):
+            if compute_dtype is not None:
+                peak *= DTYPE_PEAK_SCALE.get(str(compute_dtype), 1.0)
             return peak
     return None
 
@@ -142,6 +158,33 @@ def resolve(pp=None):
         else:
             changes[f.name] = float(raw)
     return dataclasses.replace(pp, **changes) if changes else pp
+
+
+_MXU_PREFIX = "TPU_APEX_MXU_"
+
+
+def resolve_mxu(lp=None):
+    """Apply ``TPU_APEX_MXU_<FIELD>`` env overrides to a
+    LearnerPerfParams (config.py) — the ISSUE-13 MFU-campaign knob
+    family (megabatch factor, Pallas torso), same override-by-env
+    contract as ``resolve``.  Returns a NEW instance; the input is
+    never mutated (Options rides spawn pickles)."""
+    from pytorch_distributed_tpu.config import LearnerPerfParams
+
+    if lp is None:
+        lp = LearnerPerfParams()
+    changes: Dict[str, Any] = {}
+    for f in dataclasses.fields(lp):
+        raw = os.environ.get(_MXU_PREFIX + f.name.upper())
+        if raw is None:
+            continue
+        cur = getattr(lp, f.name)
+        if isinstance(cur, bool):
+            changes[f.name] = raw.strip().lower() not in (
+                "0", "false", "off", "no", "")
+        else:
+            changes[f.name] = int(float(raw))
+    return dataclasses.replace(lp, **changes) if changes else lp
 
 
 def export_env(pp) -> None:
@@ -372,6 +415,12 @@ class PerfMonitor:
         self.enabled = self.params.enabled
         self.flops_per_update: Optional[float] = None
         self.flops_per_frame: Optional[float] = None
+        # the role's matmul compute dtype, scaling the auto-resolved MFU
+        # denominator (fp32 runs score against the fp32 peak, not the
+        # bf16 one); set by the learner from config.compute_dtype BEFORE
+        # the first drain.  An explicit peak_flops knob is never scaled
+        # — the operator named the denominator.
+        self.compute_dtype: Optional[str] = None
         self._peak: Optional[float] = None
         self._peak_resolved = False
         self.retraces = RetraceDetector()
@@ -453,6 +502,12 @@ class PerfMonitor:
 
     # -- cadence -------------------------------------------------------------
 
+    def set_compute_dtype(self, dtype: Optional[str]) -> None:
+        """Pin the dtype the MFU denominator scales by (idempotent
+        until the first drain resolves the peak)."""
+        if self.enabled and not self._peak_resolved:
+            self.compute_dtype = str(dtype) if dtype is not None else None
+
     def _peak_flops(self) -> Optional[float]:
         if not self._peak_resolved:
             self._peak_resolved = True
@@ -462,7 +517,8 @@ class PerfMonitor:
                 try:
                     import jax
 
-                    self._peak = peak_flops_of(jax.devices()[0])
+                    self._peak = peak_flops_of(jax.devices()[0],
+                                               self.compute_dtype)
                 except Exception:  # noqa: BLE001
                     self._peak = None
         return self._peak

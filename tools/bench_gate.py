@@ -55,6 +55,11 @@ SPECS: List[Tuple[str, str, str]] = [
     ("updates_per_sec_peak", "higher", "micro"),
     ("chip_bound_updates_per_sec", "higher", "micro"),
     ("families.*.updates_per_sec", "higher", "families"),
+    # ISSUE-13 megabatch capability rows: the flat families' widened-
+    # gather fused rate (bench_families MEGABATCH_FAMILIES leg) and the
+    # smoke twin — the MLP-family wins this campaign lands would
+    # otherwise be unprotected
+    ("families.*.updates_per_sec_megabatch", "higher", "families"),
     ("sampler.xla_draws_per_sec", "higher", "sampler"),
     ("sampler.pallas_draws_per_sec", "higher", "sampler"),
     ("act_ab.act_ms_host", "lower_rel", "act"),
@@ -77,6 +82,7 @@ SPECS: List[Tuple[str, str, str]] = [
     ("anakin.updates_per_sec", "higher", "anakin"),
     ("anakin.speedup_vs_device", "higher", "anakin"),
     ("smoke.updates_per_sec", "higher", "smoke"),
+    ("smoke.updates_per_sec_megabatch", "higher", "smoke"),
     ("smoke.device_env_frames_per_sec", "higher", "smoke"),
     ("smoke.anakin_frames_per_sec", "higher", "smoke"),
 ]

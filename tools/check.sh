@@ -65,6 +65,23 @@ if ! python bench.py --smoke > "$tmp/smoke.json"; then
 fi
 echo "bench --smoke: PASS"
 
+echo "== stage 2b: megabatch smoke key (ISSUE 13) =="
+# the megabatched fused-learner rate must be present and positive —
+# a smoke run that silently dropped the leg would leave the campaign's
+# capability ungated (stage 3 then regression-compares it)
+if ! python - "$tmp/smoke.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+v = d.get("smoke", {}).get("updates_per_sec_megabatch")
+assert isinstance(v, (int, float)) and v > 0, \
+    f"smoke.updates_per_sec_megabatch missing/invalid: {v!r}"
+print(f"smoke.updates_per_sec_megabatch = {v}")
+EOF
+then
+    echo "megabatch smoke key: FAIL"
+    exit 1
+fi
+
 echo "== stage 3: bench_gate vs BENCH_SMOKE_BASELINE.json =="
 # generous smoke tolerance: this stage pins the pipeline on any host;
 # same-machine perf gating uses the recorded history (TESTING.md)

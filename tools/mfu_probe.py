@@ -166,11 +166,46 @@ def op_breakdown(trace_dir: str, top: int = 12) -> list:
     return out
 
 
+# trace categories that are layout work, not model math: the re-tiling
+# share the bench's ``mfu_bound`` note quotes (ISSUE-13 satellite)
+_RETILING_CATS = ("copy", "transpose", "reshape", "convert",
+                  "data formatting")
+
+
+def attribution_of(top_ops: list) -> dict:
+    """Machine-readable attribution over an ``op_breakdown`` ranking:
+    per-category self-time bins (fractions of the ranked total) and the
+    re-tiling share (copy/transpose/reshape/convert categories) —
+    what ``bench.py`` micro's ``mfu_bound`` note consumes from an
+    ``MFU_PROBE.json`` artifact instead of a hand-copied string."""
+    rows = [r for r in top_ops if "error" not in r]
+    total = sum(r.get("self_us", 0.0) for r in rows)
+    bins: dict = {}
+    for r in rows:
+        cat = str(r.get("category", "?")).lower() or "?"
+        bins[cat] = bins.get(cat, 0.0) + r.get("self_us", 0.0)
+    if total <= 0:
+        return {"error": "no ranked ops", "bins": {}, "retiling_share": None}
+    bins = {k: round(v / total, 4) for k, v in bins.items()}
+    retiling = sum(v for k, v in bins.items()
+                   if any(t in k for t in _RETILING_CATS))
+    return {"retiling_share": round(retiling, 4), "bins": bins,
+            "basis": "fraction of ranked-op self time"}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace-dir", default="/tmp/mfu_probe_trace")
     ap.add_argument("--skip-trace", action="store_true")
     ap.add_argument("--skip-levers", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="one-line machine-readable JSON (adds the "
+                         "'attribution' section: re-tiling share + "
+                         "per-category self-time bins)")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the JSON blob to FILE (point it "
+                         "at MFU_PROBE.json in the repo root so "
+                         "bench.py's mfu_bound note quotes this probe)")
     args = ap.parse_args()
 
     import jax
@@ -195,8 +230,9 @@ def main() -> None:
     }
     if not args.skip_trace:
         capture_trace(compiled, state, ring, 32, args.trace_dir)
-        out["top_ops"] = op_breakdown(args.trace_dir)
+        out["top_ops"] = op_breakdown(args.trace_dir, top=24)
         out["trace_dir"] = args.trace_dir
+        out["attribution"] = attribution_of(out["top_ops"])
 
     if not args.skip_levers:
         # lever 1: batch 512 (same program shape, 4x rows) — if the bound
@@ -219,7 +255,11 @@ def main() -> None:
             "mfu_vs_bf16_peak": round(rf * ff / peak, 4) if ff else None,
         }
 
-    print(json.dumps(out, indent=1))
+    blob = json.dumps(out) if args.json else json.dumps(out, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(out, indent=1) + "\n")
+    print(blob)
 
 
 if __name__ == "__main__":
