@@ -1353,6 +1353,93 @@ def bench_flow_overhead(chunks: int = 600, rows: int = 16,
     return {"flow_overhead": out}
 
 
+def bench_replica_overhead(rounds: int = 200, grad_dim: int = 65536,
+                           smoke: bool = False) -> dict:
+    """Replica-plane cost on the learner hot path (ISSUE 15
+    acceptance): a real ReplicaClient→gateway→ReplicaRegistry wire loop
+    at N=1 (the solo-degenerate case every replicated learner passes
+    through) measures the per-round exchange span at a production-ish
+    gradient size (64k fp32 ≈ the dqn-mlp tree), and the plane's
+    per-round adds — the generation-stamp validate + round bookkeeping
+    (``submit`` fast path) and one lease ``renew`` (an upper bound:
+    production renews every lease_s/3, not every round) — are DIRECTLY
+    timed in isolation against the registry.  The gate number
+    ``replica_overhead_frac`` is plane-work-per-round over
+    exchange-span-per-round, held under the 0.02 absolute band by
+    bench_gate — the PR-10 lesson applies verbatim: differencing two
+    noisy round rates on this loaded host would read scheduler hiccups
+    as fake overhead, so the rate difference is never the gate number.
+
+    ``smoke=True`` shrinks the loop to sub-second for CI; the
+    measurement logic is identical."""
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.config import ReplicaParams
+    from pytorch_distributed_tpu.parallel.dcn import (
+        DcnGateway, LocalReplicaChannel, ReplicaClient, ReplicaRegistry,
+    )
+
+    plane_iters = 6_000
+    if smoke:
+        rounds = min(rounds, 80)
+        plane_iters = 2_500
+    registry = ReplicaRegistry(ReplicaParams(replicas=1, lease_s=30.0))
+    store = ParamStore(4)
+    store.publish(np.zeros(4, dtype=np.float32))
+    gw = DcnGateway(store, GlobalClock(), ActorStats(),
+                    put_chunk=lambda items: None, host="127.0.0.1",
+                    port=0, replicas=registry)
+    client = ReplicaClient(("127.0.0.1", gw.port), 0)
+    client.acquire()
+    grad = np.zeros(grad_dim, dtype=np.float32)
+    for r in range(10):  # session + allocator warmup
+        client.submit_round(r, grad)
+    t0 = time.perf_counter()
+    for r in range(10, 10 + rounds):
+        client.submit_round(r, grad)
+    span = time.perf_counter() - t0
+    # the plane's own work, timed directly against a second registry:
+    # the stamp/validate + completion bookkeeping of an N=1 submit
+    # (tiny grad — the reduce over real bytes is already inside the
+    # wire span above) and the renew path
+    reg2 = ReplicaRegistry(ReplicaParams(replicas=1, lease_s=30.0))
+    ch = LocalReplicaChannel(reg2, 0)
+    ch.acquire()
+    tiny = np.zeros(4, dtype=np.float32)
+    t0 = time.perf_counter()
+    for i in range(plane_iters):
+        ch.submit_round(i, tiny)
+    stamp_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(plane_iters):
+        ch.renew()
+    renew_s = time.perf_counter() - t0
+    client.release()
+    client.close()
+    ch.release()
+    ch.close()
+    gw.close()
+    per_round = span / max(rounds, 1)
+    per_stamp = stamp_s / max(plane_iters, 1)
+    per_renew = renew_s / max(plane_iters, 1)
+    out = {
+        "rounds_per_sec_wire": round(rounds / span, 1),
+        "round_exchange_us": round(per_round * 1e6, 2),
+        "stamp_us_per_round": round(per_stamp * 1e6, 3),
+        "renew_us": round(per_renew * 1e6, 3),
+        # the gate number: per-round plane work (stamp + one renew,
+        # the conservative bound) / per-round exchange span
+        "replica_overhead_frac": round(
+            (per_stamp + per_renew) / per_round, 4),
+        "grad_dim": grad_dim,
+        "geometry": "smoke-wire" if smoke else "wire",
+    }
+    print(f"[bench_replica_overhead] {out}", file=sys.stderr, flush=True)
+    return {"replica_overhead": out}
+
+
 def bench_smoke(updates: int = 384) -> dict:
     """Seconds-scale, CPU-safe bench for CI gating (ISSUE 6 satellite):
     the dqn-mlp learner program fused over a small uniform HBM-style
@@ -2090,7 +2177,7 @@ def main() -> None:
                                        "sampler", "act", "actor",
                                        "health", "perf", "device_env",
                                        "provenance", "metrics", "flow",
-                                       "anakin"),
+                                       "anakin", "replica"),
                     default="both")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale CPU-safe bench (the dqn-mlp "
@@ -2136,6 +2223,10 @@ def main() -> None:
         # ISSUE-11 flow-plane overhead rides the smoke output the same
         # way (additive key, schema stays 4)
         result.update(bench_flow_overhead(smoke=True))
+        # ISSUE-15 replica-plane overhead (lease renew + generation
+        # stamp vs the round-exchange span): additive key, schema
+        # stays 4; tools/check.sh stage 2c fails on its absence
+        result.update(bench_replica_overhead(smoke=True))
         # ISSUE-12 co-located loop: the closed rollout+learn pair rate
         # on a tiny fleet (additive key, schema stays 4; the full
         # section with the split-process comparison runs under --mode
@@ -2173,6 +2264,8 @@ def main() -> None:
         result.update(bench_metrics_overhead())
     if args.mode in ("both", "flow"):
         result.update(bench_flow_overhead())
+    if args.mode in ("both", "replica"):
+        result.update(bench_replica_overhead())
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))

@@ -69,6 +69,29 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
 
         return run_anakin_learner(opt, spec, process_ind, memory,
                                   param_store, clock, stats)
+    from pytorch_distributed_tpu.factory import replica_active
+
+    if replica_active(opt):
+        # the elastic multi-learner plane (ISSUE 15): N data-parallel
+        # replicas over DCN, lease-fenced membership, generation-stamped
+        # allreduce.  Delegation is gated the same LOUD-downgrade way as
+        # megabatch: an unsupported family or a topology without a
+        # registry/coordinator runs the solo loop and says so.
+        from pytorch_distributed_tpu.parallel import dcn as dcn_mod
+
+        rp = dcn_mod.resolve_replica(opt.replica_params)
+        if opt.agent_type != "dqn":
+            print(f"[learner] replicas={rp.replicas} is only supported "
+                  f"for agent_type=dqn (got {opt.agent_type}); running "
+                  f"the solo learner", flush=True)
+        elif dcn_mod.local_registry() is None and not rp.coordinator:
+            print(f"[learner] replicas={rp.replicas} needs the fleet "
+                  f"gateway's ReplicaRegistry (fleet.py --role learner) "
+                  f"or replica_params.coordinator; running the solo "
+                  f"learner", flush=True)
+        else:
+            return run_replica_learner(opt, spec, process_ind, memory,
+                                       param_store, clock, stats)
     import jax
     import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree
@@ -896,3 +919,457 @@ def memory_size(memory: Any) -> int:
     if hasattr(memory, "drain"):
         memory.drain()
     return memory.size
+
+
+# ---------------------------------------------------------------------------
+# elastic multi-learner replica plane (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def _key_data(key) -> np.ndarray:
+    """Raw uint32 view of a PRNG key (typed or raw) — the key-stream
+    schedule the parity oracle compares bit-for-bit."""
+    import jax
+
+    try:
+        return np.asarray(jax.random.key_data(key)).copy()
+    except (TypeError, AttributeError):  # raw uint32 keys
+        return np.asarray(key).copy()
+
+
+class ReplicaLearnerDriver:
+    """One data-parallel learner replica of the elastic plane
+    (ISSUE 15): the composition of the grad/apply split
+    (factory.build_replica_grad_apply), a LOCAL HBM-style PER ring
+    (memory/device_per.DevicePerReplay — every replica holds the full
+    ring; the merged write-backs keep the N rings ONE logical priority
+    plane), and the lease-fenced, generation-stamped gradient exchange
+    through the gateway registry (parallel/dcn.py).
+
+    Determinism contract (the degraded-parity oracle's substrate):
+
+    - **Params** are initialised from ``opt.seed`` identically on every
+      replica; every applied update is the registry's reduced mean, so
+      the N TrainStates can never diverge while membership is stable.
+    - **Experience** is the deterministic shared stream: ingest rows are
+      minted from a counter-keyed RNG (``np_rng(seed, "replica-ingest",
+      counter)``) every replica advances identically, so the N rings
+      hold the same rows.  (Sharding the gateway ingest across replicas
+      is the named next ROADMAP step; this plane is the fault-tolerance
+      composition it will ride on.)
+    - **Keys**: round ``r``'s sample key is ``fold_in(fold_in(base, r),
+      rank)`` with ``rank`` = this replica's index in the SORTED live
+      membership of the previous completed round.  Rank folding — not
+      world-size folding — is what makes degradation seamless: when N
+      shrinks to 1, the survivor at rank 0 draws the EXACT key stream a
+      solo driver draws, so from the degradation round onward it is
+      bit-identical to the solo learner (tests/test_replicas.py).
+    - **Priorities**: each round's |TD| write-back rides the round
+      submission; the registry's reply carries every survivor's
+      write-back in ascending-replica order and each replica applies
+      ALL of them sequentially — identical scatter sequence, identical
+      rings.  A fenced (stale-generation) write-back is a counted
+      reject at the registry and never reaches any ring.
+
+    Faults: the ``REPLICA_FAULTS`` env plane (utils/faults.py) is
+    consulted once per round — ``kill@N`` / ``hang@N[:S]`` / ``crash@N``
+    are the production drill verbs (tools/chaos_soak.py --kill-replica /
+    --hang-replica)."""
+
+    def __init__(self, opt: Options, spec: EnvSpec, replica_id: int,
+                 channel, writer=None,
+                 ingest_rows_per_round: int = 0):
+        import jax
+
+        from pytorch_distributed_tpu.factory import (
+            build_replica_grad_apply, build_train_state_and_step,
+        )
+        from pytorch_distributed_tpu.memory.device_per import (
+            DevicePerReplay,
+        )
+
+        self.opt = opt
+        self.spec = spec
+        self.replica = replica_id
+        self.channel = channel
+        self.writer = writer
+        self.ingest_rows_per_round = ingest_rows_per_round
+        ap = opt.agent_params
+        mp_ = opt.memory_params
+        model = build_model(opt, spec)
+        params = init_params(opt, spec, model, seed=opt.seed)
+        # state construction shared with the solo learner (identical
+        # optimizer chain -> checkpoint-interchangeable TrainStates);
+        # the returned fused step is discarded — replicas train through
+        # the split halves
+        state, _ = build_train_state_and_step(opt, spec, model, params)
+        pair = build_replica_grad_apply(opt, model)
+        assert pair is not None, (
+            f"replica plane does not support agent_type={opt.agent_type}")
+        grad_fn, apply_fn = pair
+        self._grad = jax.jit(grad_fn)
+        self._apply = jax.jit(apply_fn, donate_argnums=0)
+        self.state = jax.device_put(state)
+        self.replay = DevicePerReplay(
+            mp_.memory_size, spec.state_shape, spec.action_shape,
+            state_dtype=np.dtype(mp_.state_dtype),
+            action_dtype=spec.action_dtype,
+            priority_exponent=mp_.priority_exponent,
+            importance_weight=mp_.priority_weight,
+            importance_anneal_steps=ap.steps)
+        # ONE base key stream shared by every replica (index 0 on
+        # purpose: rank folding differentiates replicas, the stream
+        # itself must be common property)
+        self._base_key = jax.random.PRNGKey(
+            process_seed(opt.seed, "replica-plane", 0))
+        self.round = 0
+        self.members: list = []
+        self.key_log: list = []      # (round, raw key bytes)
+        self.fence_events = 0
+        self.rejoins = 0
+        self._ingest_counter = 0
+        self._recorder = flight_recorder.get_recorder(
+            f"replica-{replica_id}")
+
+    # -- deterministic shared ingest ----------------------------------------
+
+    def _synth_chunk(self, rows: int) -> Any:
+        """``rows`` transitions minted from the counter-keyed shared
+        stream — identical bytes on every replica at the same counter."""
+        from pytorch_distributed_tpu.utils.experience import Transition
+
+        ap = self.opt.agent_params
+        rng = np_rng(self.opt.seed, "replica-ingest",
+                     self._ingest_counter)
+        self._ingest_counter += 1
+        shape = (rows,) + tuple(self.spec.state_shape)
+        sdt = np.dtype(self.opt.memory_params.state_dtype)
+        if sdt.kind == "u":
+            s0 = rng.integers(0, 256, size=shape).astype(sdt)
+            s1 = rng.integers(0, 256, size=shape).astype(sdt)
+        else:
+            s0 = rng.standard_normal(shape).astype(sdt)
+            s1 = rng.standard_normal(shape).astype(sdt)
+        if self.spec.discrete:
+            action = rng.integers(
+                0, max(1, self.spec.num_actions),
+                size=(rows,)).astype(np.int32)
+        else:
+            action = rng.standard_normal(
+                (rows, self.spec.action_dim)).astype(np.float32)
+        return Transition(
+            state0=s0,
+            action=action,
+            reward=rng.standard_normal(rows).astype(np.float32),
+            gamma_n=np.full(rows, ap.gamma ** ap.nstep, np.float32),
+            state1=s1,
+            terminal1=(rng.random(rows) < 0.05).astype(np.float32),
+        )
+
+    def prefill(self, rows: int) -> None:
+        self.replay.feed_chunk(self._synth_chunk(rows))
+
+    # -- state capture / restore (the oracle + the rejoin leg) ---------------
+
+    def snapshot(self) -> dict:
+        import jax
+
+        return {
+            "state": jax.device_get(self.state),
+            "ring": jax.device_get(self.replay.state),
+            "round": self.round,
+            "ingest_counter": self._ingest_counter,
+        }
+
+    def load_snapshot(self, snap: dict) -> None:
+        import jax
+
+        self.state = jax.device_put(snap["state"])
+        self.replay.state = jax.device_put(snap["ring"])
+        self.round = snap["round"]
+        self._ingest_counter = snap["ingest_counter"]
+
+    @property
+    def lstep(self) -> int:
+        import jax
+
+        return int(jax.device_get(self.state.step))
+
+    def _commit_epoch(self) -> int:
+        extras = dict(
+            learner_step=self.lstep,
+            replica_round=self.round,
+            replica_ingest_counter=self._ingest_counter,
+        )
+        ckpt.save_epoch(
+            self.opt.model_name, state=self.state, memory=self.replay,
+            extras=extras, retain=self.opt.agent_params.checkpoint_retain)
+        return self.lstep
+
+    # -- the round loop ------------------------------------------------------
+
+    def _rank(self) -> int:
+        if not self.members:
+            return 0
+        try:
+            return sorted(self.members).index(self.replica)
+        except ValueError:
+            return 0
+
+    def run_rounds(self, until_round: int, *, stop=None, faults=None,
+                   capture=None, on_round=None, rejoin: bool = False,
+                   stats_every: int = 0) -> None:
+        """Drive rounds ``[self.round, until_round)``.  ``capture(r,
+        driver)`` fires after round ``r`` is fully applied (state,
+        ring, key log current).  ``rejoin=True`` turns a fence into the
+        epoch-barrier rejoin path instead of an exception."""
+        import jax
+
+        from pytorch_distributed_tpu.parallel.dcn import (
+            RSTAT_OK, ReplicaFenced,
+        )
+        from pytorch_distributed_tpu.parallel.learner import (
+            ReplicaExchange,
+        )
+
+        inj = faults if faults is not None \
+            else FaultInjector.from_env("replica")
+        exchange = ReplicaExchange(self.channel)
+        t_win = time.monotonic()
+        r_win = self.round
+        while self.round < until_round:
+            if stop is not None and stop.is_set():
+                return
+            r = self.round
+            # the production fault plane: kill@N / hang@N / crash@N /
+            # delay@N:S fire HERE, once per round
+            inj.frame(b"")
+            if self.ingest_rows_per_round > 0:
+                self.prefill(self.ingest_rows_per_round)
+            rank = self._rank()
+            key = jax.random.fold_in(
+                jax.random.fold_in(self._base_key, r), rank)
+            self.key_log.append((r, _key_data(key)))
+            beta = self.replay.beta(self.lstep)
+            batch = self.replay.sample(
+                self.opt.agent_params.batch_size, key, beta=beta)
+            grads, ok, _metrics, td_abs = self._grad(self.state, batch)
+            pidx = np.asarray(jax.device_get(batch.index), np.int32)
+            ptd = np.abs(np.asarray(jax.device_get(td_abs), np.float32))
+            try:
+                reply, reduced = exchange.exchange(
+                    r, grads, ok=bool(float(jax.device_get(ok)) > 0),
+                    pidx=pidx, ptd=ptd)
+            except (ConnectionError, OSError) as e:
+                raise ReplicaFenced(
+                    f"replica {self.replica} lost the registry: {e}")
+            if reply["status"] != RSTAT_OK:
+                self.fence_events += 1
+                self._recorder.record("replica-fenced", round=r,
+                                      status=reply["status"])
+                if rejoin:
+                    self.rejoin()
+                    continue
+                raise ReplicaFenced(
+                    f"replica {self.replica} fenced at round {r} "
+                    f"(status {reply['status']})")
+            self.members = list(reply["members"])
+            if reduced is not None:
+                self.state = self._apply(self.state, reduced,
+                                         np.float32(1.0))
+            # merged |TD| write-backs, applied in the reply's
+            # deterministic order on EVERY replica — one logical
+            # priority plane across N rings
+            # (memory/device_per.per_apply_writeback_groups)
+            from pytorch_distributed_tpu.memory.device_per import (
+                per_apply_writeback_groups,
+            )
+
+            self.replay.state = per_apply_writeback_groups(
+                self.replay.state,
+                [(w[1], w[2]) for w in reply["writebacks"]],
+                alpha=self.replay.alpha)
+            self.round = r + 1
+            if reply.get("epoch_due") and self._rank() == 0:
+                step = self._commit_epoch()
+                self.channel.note_epoch(r, step)
+            if capture is not None:
+                capture(r, self)
+            if on_round is not None:
+                on_round(r, reply)
+            if stats_every and (r + 1) % stats_every == 0 \
+                    and self.writer is not None:
+                now = time.monotonic()
+                self.writer.scalar(
+                    "learner/updates_per_s",
+                    (self.round - r_win) / max(now - t_win, 1e-9),
+                    step=self.lstep)
+                self.writer.scalar("replica/round", float(self.round),
+                                   step=self.lstep)
+                self.writer.flush()
+                t_win, r_win = now, self.round
+
+    # -- elastic rejoin ------------------------------------------------------
+
+    def rejoin(self, timeout: float = 60.0) -> None:
+        """Rejoin at a NEW generation: re-lease, wait for the join
+        barrier's committed epoch, load that exact state (params, opt
+        state, ring, counters), fast-forward to the join round, and
+        activate — the survivors held the entry round for us."""
+        from pytorch_distributed_tpu.parallel.dcn import ReplicaFenced
+
+        reply = self.channel.acquire()
+        self.rejoins += 1
+        self.members = list(reply.get("members", []))
+        self.channel.start_renewer()
+        barrier = reply.get("epoch_barrier")
+        self._recorder.record("rejoin", generation=reply["generation"],
+                              barrier=barrier)
+        if barrier is None:
+            # no live peers = a fresh plane OR a whole-fleet restart
+            # behind a fresh registry.  "Rejoin = fetch the latest
+            # committed epoch": restore it exactly as the solo learner
+            # would (resume="never" opts out, same contract), so a
+            # supervisor-restarted replicated fleet never silently
+            # retrains from seed-initialised params
+            self.round = int(reply.get("round", 0))
+            if self.opt.resume != "never":
+                info = ckpt.resolve_epoch(self.opt.model_name)
+                if info is not None:
+                    import jax
+
+                    self.state = jax.device_put(ckpt.load_epoch_state(
+                        info, jax.device_get(self.state)))
+                    if info.has_replay:
+                        ckpt.load_epoch_replay(info, self.replay)
+                    self.round = max(self.round, int(
+                        info.extras.get("replica_round", 0)))
+                    self._ingest_counter = int(info.extras.get(
+                        "replica_ingest_counter",
+                        self._ingest_counter))
+                    print(f"[replica] {self.replica} resumed epoch "
+                          f"{info.epoch} (step {info.learner_step}, "
+                          f"round {self.round})", flush=True)
+            return
+        deadline = time.monotonic() + timeout
+        epoch_step = None
+        while time.monotonic() < deadline:
+            j = self.channel.poll_join()
+            if j is None:
+                # join cancelled (timeout server-side): fenced again
+                raise ReplicaFenced(
+                    f"replica {self.replica} join cancelled")
+            if j.get("epoch_step") is not None:
+                epoch_step = int(j["epoch_step"])
+                break
+            time.sleep(0.05)
+        if epoch_step is None:
+            raise ReplicaFenced(
+                f"replica {self.replica} barrier epoch never committed")
+        info = ckpt.await_epoch(self.opt.model_name, epoch_step,
+                                timeout=max(5.0, deadline
+                                            - time.monotonic()))
+        if info is None:
+            raise ReplicaFenced(
+                f"replica {self.replica} could not resolve the barrier "
+                f"epoch (step >= {epoch_step})")
+        import jax
+
+        self.state = jax.device_put(ckpt.load_epoch_state(
+            info, jax.device_get(self.state)))
+        if info.has_replay:
+            ckpt.load_epoch_replay(info, self.replay)
+        self.round = int(reply.get("round",
+                                   info.extras.get("replica_round", 0)))
+        self._ingest_counter = int(info.extras.get(
+            "replica_ingest_counter", self._ingest_counter))
+        act = self.channel.activate(epoch_step)
+        self.members = list(act.get("members", self.members))
+        print(f"[replica] {self.replica} rejoined at generation "
+              f"{self.channel.generation}, round {self.round} "
+              f"(epoch step {epoch_step})", flush=True)
+
+
+def run_replica_learner(opt: Options, spec: EnvSpec, process_ind: int,
+                        memory: Any, param_store: ParamStore,
+                        clock: GlobalClock, stats: LearnerStats,
+                        replica_id: Optional[int] = None) -> None:
+    """Production wrapper around ``ReplicaLearnerDriver``: the learner
+    role of a replicated fleet.  Replica 0 is the LEAD — it runs in the
+    gateway's own process and joins through a LocalReplicaChannel
+    against the in-process registry (fleet.FleetTopology wires it);
+    replicas >= 1 run on other hosts (``fleet.py --role
+    learner-replica``) and dial ``replica_params.coordinator``.  The
+    ``memory`` handle of the solo learner is not consumed — the replica
+    plane's experience is the deterministic shared stream (driver
+    docstring); a loud note says so once."""
+    from pytorch_distributed_tpu.parallel import dcn as dcn_mod
+
+    rp = dcn_mod.resolve_replica(opt.replica_params)
+    rid = int(replica_id if replica_id is not None else process_ind)
+    registry = dcn_mod.local_registry()
+    if registry is not None:
+        channel = dcn_mod.LocalReplicaChannel(registry, rid)
+    else:
+        host, _, port = rp.coordinator.rpartition(":")
+        channel = dcn_mod.ReplicaClient((host, int(port)), rid,
+                                        params=rp)
+    ap = opt.agent_params
+    timing_writer = MetricsWriter(opt.log_dir, enable_tensorboard=False,
+                                  role="learner", run_id=opt.refs)
+    driver = ReplicaLearnerDriver(opt, spec, rid, channel,
+                                  writer=timing_writer,
+                                  ingest_rows_per_round=0)
+    if memory is not None:
+        print(f"[replica] {rid}: the replica plane trains from the "
+              f"deterministic shared stream; the local ingest queue is "
+              f"drained but not consumed (sharded gateway ingest is the "
+              f"next ROADMAP step)", flush=True)
+    # the initial lease goes through the REJOIN path: a fresh plane
+    # grants round 0 and falls through; a replacement process entering
+    # mid-training gets the join barrier and syncs from the committed
+    # epoch instead of bouncing a stale round 0 off the registry
+    driver.rejoin(timeout=max(60.0, 4.0 * rp.join_timeout_s))
+    channel.wait_members(rp.replicas,
+                         timeout=4.0 * max(rp.lease_s, 0.5))
+    driver.members = channel.members()
+    if driver.round == 0:
+        driver.prefill(min(max(ap.learn_start, ap.batch_size),
+                           opt.memory_params.memory_size))
+
+    import jax
+    from jax.flatten_util import ravel_pytree
+
+    from pytorch_distributed_tpu.factory import published_params
+
+    def _publish() -> None:
+        flat, _ = ravel_pytree(jax.device_get(
+            published_params(opt, driver.state)))
+        param_store.publish(np.asarray(flat, dtype=np.float32))
+
+    _publish()
+
+    def _on_round(r: int, reply: dict) -> None:
+        clock.bump_progress("learner")
+        clock.set_learner_step(driver.lstep)
+        if ap.param_publish_freq and \
+                (r + 1) % ap.param_publish_freq == 0:
+            _publish()
+        if ap.checkpoint_freq and (r + 1) % ap.checkpoint_freq == 0 \
+                and driver._rank() == 0:
+            driver._commit_epoch()
+        if memory is not None and hasattr(memory, "drain"):
+            # keep a hybrid topology's ingest queue from backing up
+            # while the replica plane trains from the shared stream
+            memory.drain()
+
+    try:
+        driver.run_rounds(ap.steps, stop=clock.stop,
+                          on_round=_on_round, rejoin=(rid != 0),
+                          stats_every=max(1, ap.learner_freq))
+    finally:
+        _publish()
+        if driver._rank() == 0 and driver.round > 0:
+            driver._commit_epoch()
+        channel.release()
+        channel.close()
+        timing_writer.close()

@@ -116,6 +116,10 @@ KNOBS = (
     ("TPU_APEX_MXU_*", "utils/perf.py",
      "per-field LearnerPerfParams overrides — the ISSUE-13 MFU-campaign "
      "levers (e.g. TPU_APEX_MXU_MEGABATCH, TPU_APEX_MXU_PALLAS_TORSO)"),
+    ("TPU_APEX_REPLICA_*", "parallel/dcn.py",
+     "per-field ReplicaParams overrides — the ISSUE-15 multi-learner "
+     "replica plane (e.g. TPU_APEX_REPLICA_REPLICAS, "
+     "TPU_APEX_REPLICA_LEASE_S)"),
 )
 
 
@@ -660,6 +664,54 @@ class AnakinParams:
 
 
 @dataclass
+class ReplicaParams:
+    """Elastic multi-learner replica plane knobs (ISSUE 15;
+    parallel/dcn.py ReplicaRegistry / agents/learner.py replica driver —
+    no reference equivalent: the reference's ``num_learners > 1`` hook
+    races unsynchronized Adam steps on one shared CUDA model).  Every
+    field is env-overridable as ``TPU_APEX_REPLICA_<FIELD>`` via
+    ``parallel.dcn.resolve_replica``, the same spawn-inheritance
+    contract the health/perf/flow planes use.
+
+    N data-parallel learner replicas train one logical model over DCN:
+    replicas hold renewable LEASES with monotonic generation numbers on
+    the lead gateway; a missed lease expires the replica and FENCES its
+    stragglers (a stale-generation gradient or priority write-back is a
+    counted reject, never applied — the slot-fencing contract of PR 1,
+    lifted to the learner plane).  The gradient exchange is a
+    generation-stamped allreduce round that reconfigures on membership
+    change: when a replica dies mid-round, survivors complete the round
+    over the surviving set within one lease window; at N=1 the survivor
+    is bit-identical to the solo learner (tests/test_replicas.py
+    oracle).  The dp-mesh ``psum`` path (parallel/learner.py) stays the
+    in-host fast path — this plane composes ACROSS hosts."""
+
+    # Configured replica count (1 = plane off: the solo learner runs
+    # exactly as before, no registry, no stamps).  The plane is elastic
+    # below this: fewer live members is a DEGRADED (alerted) state, not
+    # an error.
+    replicas: int = 1
+    # Lease window, seconds: a replica that neither renews nor submits
+    # within it is expired and fenced.  Also the round-stall window —
+    # once any member has contributed to a round, members that stay
+    # silent past one lease window are expelled and the round completes
+    # over the surviving set.
+    lease_s: float = 5.0
+    # Background renew cadence, seconds (0 = lease_s / 3).
+    renew_s: float = 0.0
+    # Hard cap, seconds, on one blocking round exchange before the
+    # submitting replica gives up (0 = 3 lease windows — strictly after
+    # the stall expulsion above, so it only fires on a wedged registry).
+    round_timeout_s: float = 0.0
+    # Seconds a pending rejoiner may take to load the barrier epoch and
+    # activate before its join is cancelled and survivors proceed.
+    join_timeout_s: float = 30.0
+    # Lead gateway ``host:port`` a remote replica host dials
+    # (fleet.py --role learner-replica --coordinator).
+    coordinator: str = ""
+
+
+@dataclass
 class LearnerPerfParams:
     """MFU-campaign knobs (ISSUE 13; no reference equivalent — the
     reference never measures device utilization at all).  Every field
@@ -780,6 +832,7 @@ class Options:
     anakin_params: AnakinParams = field(default_factory=AnakinParams)
     learner_perf_params: LearnerPerfParams = field(
         default_factory=LearnerPerfParams)
+    replica_params: ReplicaParams = field(default_factory=ReplicaParams)
 
     @property
     def model_dir(self) -> str:
@@ -874,7 +927,7 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
                     "agent_params", "parallel_params", "health_params",
                     "perf_params", "metrics_params", "alert_params",
                     "flow_params", "anakin_params",
-                    "learner_perf_params"):
+                    "learner_perf_params", "replica_params"):
             subobj = getattr(opt, sub)
             if hasattr(subobj, key):
                 hits.append((sub, subobj))

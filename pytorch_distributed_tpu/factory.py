@@ -790,6 +790,44 @@ def resolve_megabatch(opt: Options, steps_per_call: int
     return M, K
 
 
+def build_replica_grad_apply(opt: Options, model):
+    """The ISSUE-15 replica-plane twin of ``build_train_state_and_step``:
+    the dqn update factored at the gradient boundary
+    (ops/losses.build_dqn_grad_and_apply) so the replica driver can
+    allreduce gradients over DCN between the halves.  The optimizer and
+    train apply are constructed EXACTLY as the sequential builder
+    constructs them (one ``_dqn_train_apply`` gate, one
+    ``make_optimizer`` call), so a TrainState initialised — or
+    checkpointed — by the solo learner is directly consumable by a
+    replica, and vice versa.  Returns ``(grad_fn, apply_grads)`` or
+    None for families without replica support (callers downgrade
+    loudly)."""
+    from pytorch_distributed_tpu.ops.losses import (
+        build_dqn_grad_and_apply, make_optimizer,
+    )
+
+    if opt.agent_type != "dqn":
+        return None
+    ap = opt.agent_params
+    tx = make_optimizer(ap.lr, ap.clip_grad, ap.weight_decay,
+                        lr_decay_steps=(ap.steps if ap.lr_decay else 0))
+    return build_dqn_grad_and_apply(
+        _dqn_train_apply(opt, model), tx,
+        enable_double=ap.enable_double,
+        target_model_update=ap.target_model_update,
+    )
+
+
+def replica_active(opt: Options) -> bool:
+    """Is the elastic multi-learner plane engaged (ISSUE 15)?  One
+    resolution point (parallel.dcn.resolve_replica applies the
+    TPU_APEX_REPLICA_* env contract) shared by the runtime wiring, the
+    learner delegation and the fleet CLI."""
+    from pytorch_distributed_tpu.parallel.dcn import resolve_replica
+
+    return resolve_replica(opt.replica_params).replicas > 1
+
+
 def published_params(opt: Options, state) -> Any:
     """The param tree the learner publishes to actors: the full model tree
     (merged back for decoupled DDPG, whose TrainState splits it)."""

@@ -93,6 +93,20 @@ class FleetTopology(Topology):
 
         self._actor_backend = resolve_actor_backend(
             opt, self.inference_server)
+        # elastic multi-learner plane (ISSUE 15): the lease-fenced
+        # membership registry + round coordinator rides THIS gateway;
+        # the lead learner (replica 0, this process) joins through the
+        # module-local handle instead of dialling loopback
+        if self.replica.replicas > 1:
+            from pytorch_distributed_tpu.parallel.dcn import (
+                ReplicaRegistry, set_local_registry,
+            )
+
+            self.replica_registry = ReplicaRegistry(
+                self.replica,
+                writer=(self.mission._writer
+                        if self.mission is not None else None))
+            set_local_registry(self.replica_registry)
         self.gateway = self._make_gateway(port)
         self.port = self.gateway.port
         if self.perf.enabled:
@@ -127,7 +141,8 @@ class FleetTopology(Topology):
             # incident timeline) can see them; mission-off runs keep
             # the flight-recorder leg only
             flow_writer=(self.mission._writer
-                         if self.mission is not None else None))
+                         if self.mission is not None else None),
+            replicas=self.replica_registry)
 
     def _flow_pressure(self) -> float:
         """The overload governor's input signal: ingest-queue
@@ -311,6 +326,16 @@ class FleetTopology(Topology):
         # stop accepting/serving before the learner-side queue closes:
         # an in-flight EXP put on a closed queue would kill a serve thread
         self.gateway.close()
+        if self.replica_registry is not None:
+            # drop the module-local handle: a LATER topology in this
+            # process (test suites, embedders) must not silently wire
+            # its lead learner to this closed run's registry
+            from pytorch_distributed_tpu.parallel.dcn import (
+                local_registry, set_local_registry,
+            )
+
+            if local_registry() is self.replica_registry:
+                set_local_registry(None)
 
     def restart_gateway(self) -> None:
         """Tear the gateway down and rebind on the same port — the
@@ -336,6 +361,62 @@ def run_fleet_learner(opt: Options, local_actors: int = 0, port: int = 5555,
           f"{topo.local_actors}/{opt.num_actors} actors local")
     topo.run(backend=backend)
     return topo
+
+
+# ---------------------------------------------------------------------------
+# replica learner host (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def run_replica_host(opt: Options, coordinator: str,
+                     replica_id: int) -> None:
+    """One remote learner replica: dials the lead gateway's replica
+    plane (lease + generation-stamped rounds) and trains the shared
+    model data-parallel (agents/learner.py run_replica_learner).  Exit
+    codes mirror the actor host contract: run complete exits 0; a
+    terminal fence whose rejoin failed exits EXIT_DISCONNECTED so an
+    outer supervisor can respawn the replica — which will re-lease at a
+    new generation and sync from the join-barrier epoch."""
+    from pytorch_distributed_tpu.factory import probe_env
+    from pytorch_distributed_tpu.agents.clocks import (
+        GlobalClock, LearnerStats,
+    )
+    from pytorch_distributed_tpu.agents.learner import run_replica_learner
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.parallel.dcn import ReplicaFenced
+    from pytorch_distributed_tpu.utils import flight_recorder
+    from pytorch_distributed_tpu.utils.helpers import tree_size
+    from pytorch_distributed_tpu.utils.supervision import EXIT_DISCONNECTED
+
+    opt.replica_params.coordinator = coordinator
+    flight_recorder.configure(opt.log_dir, run_id=opt.refs)
+    spec = probe_env(opt)
+    from pytorch_distributed_tpu.factory import build_model, init_params
+
+    store = ParamStore(tree_size(init_params(
+        opt, spec, build_model(opt, spec), seed=opt.seed)))
+    clock = GlobalClock()
+    # SIGTERM = preemption notice, same contract as every other host
+    # (runtime.py / run_fleet_actors): drain the round loop, publish +
+    # commit, release the lease, exit 0 — the next incarnation rejoins
+    # through the epoch barrier
+    if threading.current_thread() is threading.main_thread():
+        try:
+            signal.signal(signal.SIGTERM,
+                          lambda s, f: clock.stop.set())
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+    print(f"[fleet] replica host up: replica {replica_id} -> "
+          f"{coordinator}")
+    try:
+        run_replica_learner(opt, spec, replica_id, None, store,
+                            clock, LearnerStats(),
+                            replica_id=replica_id)
+    except (ReplicaFenced, ConnectionError, OSError) as e:
+        print(f"[fleet] replica-{replica_id} lost its lease/session "
+              f"({e}); exiting {EXIT_DISCONNECTED} for the supervisor")
+        flight_recorder.dump_all(
+            f"replica-{replica_id} fenced/disconnected")
+        sys.exit(EXIT_DISCONNECTED)
 
 
 # ---------------------------------------------------------------------------
@@ -658,7 +739,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap = argparse.ArgumentParser(
         prog="pytorch_distributed_tpu.fleet",
         description="multi-host Ape-X fleet launcher")
-    ap.add_argument("--role", choices=("learner", "actors"), required=True)
+    ap.add_argument("--role",
+                    choices=("learner", "actors", "learner-replica"),
+                    required=True)
+    ap.add_argument("--replica-id", type=int, default=1,
+                    help="[learner-replica] this host's replica id "
+                         "(replica 0 is the lead learner host; ids "
+                         "must be unique across the fleet)")
     ap.add_argument("--config", type=int, default=1)
     ap.add_argument("--num-actors", type=int, default=None,
                     help="TOTAL fleet actor count (defaults to config)")
@@ -749,6 +836,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     if args.role == "learner":
         run_fleet_learner(opt, local_actors=args.local_actors,
                           port=args.port)
+    elif args.role == "learner-replica":
+        assert args.coordinator, "--coordinator host:port required"
+        run_replica_host(opt, args.coordinator, args.replica_id)
     else:
         assert args.coordinator, "--coordinator host:port required"
         abandoned = run_fleet_actors(opt, args.coordinator, args.actor_base,

@@ -161,6 +161,34 @@ def per_update_priorities(state: PerReplayState, idx: jax.Array,
     )
 
 
+# one jitted write-back program shared by every caller of the grouped
+# apply below (alpha is static: one value per run, one compile)
+_writeback_jit = jax.jit(per_update_priorities,
+                         static_argnames=("alpha", "epsilon"))
+
+
+def per_apply_writeback_groups(state: PerReplayState, groups,
+                               alpha: float) -> PerReplayState:
+    """Apply an ORDERED list of ``(idx, td_abs)`` write-back groups
+    sequentially — the ISSUE-15 merged-priority application.  The
+    replica plane's round reply carries every surviving contributor's
+    |TD| write-back (ascending replica order, then out-of-round
+    arrivals), and every replica applies the SAME groups in the SAME
+    order through this function, so the N local rings remain one
+    logical priority plane bit-for-bit.
+
+    Sequential jitted scatters on purpose, not one fused scatter:
+    XLA's duplicate-index ``.set`` order within a single scatter is
+    unspecified, and cross-group index collisions must resolve exactly
+    last-group-wins for the solo-parity oracle to hold."""
+    for idx, td in groups:
+        state = _writeback_jit(state,
+                               jnp.asarray(idx, jnp.int32),
+                               jnp.asarray(td, jnp.float32),
+                               alpha=alpha)
+    return state
+
+
 class DevicePerReplay(DeviceReplay):
     """Stateful wrapper owning the HBM PER ring (learner process only):
     the uniform ring (device_replay.py DeviceReplay) extended with the

@@ -150,6 +150,84 @@ def build_dqn_train_step(
     return finite_guard(step) if guard else step
 
 
+def build_dqn_grad_and_apply(
+    apply_fn: Callable,
+    tx: optax.GradientTransformation,
+    *,
+    enable_double: bool = False,
+    target_model_update: float = 250,
+    huber: bool = False,
+) -> Tuple[Callable, Callable]:
+    """The ISSUE-15 replica split of ``build_dqn_train_step``: the same
+    update factored at the gradient boundary so N data-parallel learner
+    replicas can allreduce over DCN between the two halves.
+
+    - ``grad_fn(state, batch) -> (grads, ok, metrics, td_abs)`` computes
+      the gradients at the CURRENT params (the exact loss/double-DQN/
+      |TD| math of the fused step) plus a finiteness flag ``ok`` (f32
+      0/1 over loss, td and every grad leaf — the per-contribution twin
+      of ``finite_guard``: a diverged replica's NaN gradient must be
+      excluded from the reduce, not poison every survivor).
+    - ``apply_grads(state, grads, ok) -> state`` applies an (already
+      reduced) gradient tree: optimizer update, step increment and the
+      target cadence chained exactly as the fused step chains them;
+      ``ok <= 0`` selects the INPUT state through unchanged (a round
+      with zero valid contributions is a skipped step, like the guard).
+
+    The halves compose to the fused step's semantics; at world size 1
+    the reduced gradient IS the local gradient (mean over one
+    contributor divides by 1.0 — an IEEE identity), which is what makes
+    the degraded-to-solo parity oracle (tests/test_replicas.py) a
+    bit-exact check rather than a tolerance one."""
+
+    def grad_fn(state: TrainState, batch: Batch):
+        def loss_fn(params):
+            q = apply_fn(params, batch.state0)
+            a = batch.action.astype(jnp.int32).reshape(-1, 1)
+            q_sel = jnp.take_along_axis(q, a, axis=1)[:, 0]
+            q_next = apply_fn(state.target_params, batch.state1)
+            if enable_double:
+                a_next = jnp.argmax(apply_fn(params, batch.state1),
+                                    axis=-1)
+                bootstrap = jnp.take_along_axis(
+                    q_next, a_next[:, None], axis=1)[:, 0]
+            else:
+                bootstrap = jnp.max(q_next, axis=-1)
+            target = (batch.reward
+                      + batch.gamma_n * bootstrap
+                      * (1.0 - batch.terminal1))
+            loss, td_abs = _value_loss(q_sel, target, batch.weight,
+                                       huber)
+            return loss, (td_abs, jnp.mean(jnp.max(q, axis=-1)))
+
+        (loss, (td_abs, q_mean)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        ok = jnp.isfinite(loss) & jnp.all(jnp.isfinite(td_abs))
+        for leaf in jax.tree_util.tree_leaves(grads):
+            ok = ok & jnp.all(jnp.isfinite(leaf))
+        metrics = {
+            "learner/critic_loss": loss,
+            "learner/q_mean": q_mean,
+            "learner/grad_norm": global_norm(grads),
+        }
+        return grads, ok.astype(jnp.float32), metrics, td_abs
+
+    def apply_grads(state: TrainState, grads, ok):
+        updates, opt_state = tx.update(grads, state.opt_state,
+                                       state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_step = state.step + 1
+        target_params = update_target(state.target_params, params,
+                                      new_step, target_model_update)
+        new = TrainState(params, target_params, opt_state, new_step)
+        # ok <= 0: the whole round was invalid — pass the input state
+        # through per-leaf, exactly finite_guard's skip semantics
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(ok > 0, a, b), new, state)
+
+    return grad_fn, apply_grads
+
+
 def _per_minibatch_ok(*arrays, grads=None):
     """(M,) float32 validity mask over a megabatch group: 1.0 where every
     per-minibatch quantity (loss/td rows, every grad leaf) is finite —

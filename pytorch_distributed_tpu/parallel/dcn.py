@@ -79,6 +79,21 @@ tools/chaos_soak.py, policy knobs via ``DCN_*`` env vars):
   ``DcnClient.stop`` is set only by a T_CLOCK reply carrying
   ``stop: true``; ``DcnClient.disconnected`` only by a terminal session
   loss.  fleet.py maps them to exit codes 0 / EXIT_DISCONNECTED.
+- **Learner replicas are leased, not sessioned (ISSUE 15,
+  ReplicaRegistry below).**  N data-parallel learner replicas hold
+  renewable leases with MONOTONIC generation numbers; a missed lease
+  expires the replica and fences its stragglers (stale-generation
+  gradient/priority write-backs are counted rejects, never applied —
+  the slot-fencing contract lifted to the learner plane).  The gradient
+  exchange is a generation-stamped allreduce round that reconfigures on
+  membership change: a dead replica's round completes over the
+  surviving set within one lease window (a HUNG-but-renewing replica is
+  expelled by the round-stall rule — leases prove liveness, rounds
+  prove progress), and an N=1 completion is bit-identical to the solo
+  learner.  Rejoin = re-lease at a new generation + sync from the
+  join-barrier checkpoint epoch.  Drilled by ``chaos_soak
+  --kill-replica / --hang-replica / --rejoin`` and the
+  tests/test_replicas.py parity oracle.
 
 Client-side adapters (``RemoteMemory``, ``RemoteParamStore``,
 ``RemoteClock``, ``RemoteStats``) present the exact surfaces the actor
@@ -141,6 +156,24 @@ T_METRICS = 11  # JSON {rows, offset?, host?} -> T_METRICS JSON reply
 #                learner-host aggregator on the stats cadence; the
 #                reply's ``wall`` lets the pusher estimate its clock
 #                offset NTP-style — utils/telemetry.MetricsPusher)
+# ---- the elastic multi-learner replica plane (ISSUE 15).  Sessionless-
+# adjacent: no actor-slot HELLO — membership is the LEASE table below,
+# riding the same incarnation-fencing idea as slot claims.  Outside the
+# gateway's wire fault plane like T_STATUS (replica drills inject at the
+# replica driver through REPLICA_FAULTS — utils/faults.py — where a
+# kill/hang is the real failure mode; routing these frames through the
+# wire injector would also shift every existing drill's frame schedule).
+T_RLEASE = 12   # JSON {action, replica, incarnation|generation, ...}
+#                -> JSON reply: lease acquire/renew/release/activate/
+#                epoch/status against the gateway's ReplicaRegistry
+T_RGRAD = 13    # savez round submission (generation-stamped gradient +
+#                PER write-back) -> savez reply (reduced gradient,
+#                merged write-backs, surviving membership); BLOCKS the
+#                serve thread until the round completes or fences
+T_RPRIO = 14    # savez out-of-round |TD| priority write-back -> JSON
+#                reply; stale-generation writes are counted rejects
+#                (last-generation-wins fencing: a zombie replica can
+#                never resurrect stale priorities)
 
 _MAX_FRAME = 1 << 31  # 2 GiB — far above any chunk; rejects garbage lengths
 
@@ -290,6 +323,1092 @@ def decode_chunk(payload: bytes
 
 
 # ---------------------------------------------------------------------------
+# elastic multi-learner replica plane (ISSUE 15): lease-fenced membership
+# + fault-tolerant, generation-stamped gradient exchange
+# ---------------------------------------------------------------------------
+
+def resolve_replica(rp=None):
+    """ReplicaParams + ``TPU_APEX_REPLICA_<FIELD>`` env overrides — the
+    same override-by-env contract as the health/perf/flow planes
+    (flow.resolve_flow is the template).  Returns a NEW instance; the
+    input is never mutated (Options rides spawn pickles)."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.config import ReplicaParams
+
+    if rp is None:
+        rp = ReplicaParams()
+    changes: Dict[str, Any] = {}
+    for f in dataclasses.fields(rp):
+        raw = os.environ.get("TPU_APEX_REPLICA_" + f.name.upper())
+        if raw is None:
+            continue
+        cur = getattr(rp, f.name)
+        if isinstance(cur, bool):
+            changes[f.name] = raw.strip().lower() not in (
+                "0", "false", "off", "no", "")
+        elif isinstance(cur, int) and not isinstance(cur, bool):
+            changes[f.name] = int(float(raw))
+        elif isinstance(cur, float):
+            changes[f.name] = float(raw)
+        else:
+            changes[f.name] = raw.strip()
+    return dataclasses.replace(rp, **changes) if changes else rp
+
+
+def export_replica_env(rp) -> None:
+    """Export a RESOLVED ReplicaParams into the environment so spawn
+    children resolve the same plane the topology configured
+    programmatically.  setdefault: an operator's explicit env wins."""
+    import dataclasses
+
+    for f in dataclasses.fields(rp):
+        val = getattr(rp, f.name)
+        if val != f.default:
+            os.environ.setdefault("TPU_APEX_REPLICA_" + f.name.upper(),
+                                  str(val))
+
+
+# the in-process registry handle: FleetTopology sets it at construction
+# so the lead learner (which runs in the gateway's own process) joins
+# the replica plane through a LocalReplicaChannel instead of dialling
+# its own gateway over loopback
+_LOCAL_REGISTRY: List[Any] = [None]
+
+
+def set_local_registry(registry) -> None:
+    _LOCAL_REGISTRY[0] = registry
+
+
+def local_registry():
+    return _LOCAL_REGISTRY[0]
+
+
+# T_RGRAD / T_RPRIO round status codes (int64 ``status`` column)
+RSTAT_OK = 0        # round completed; reduced gradient + merge attached
+RSTAT_FENCED = 1    # submitter's lease is gone / generation superseded
+RSTAT_STALE = 2     # stale round or stale generation: counted reject
+RSTAT_TIMEOUT = 3   # round could not complete (wedged registry guard)
+RSTAT_NOREG = 4     # no ReplicaRegistry wired on this gateway
+
+# every savez column the replica round codec may ship, either direction
+# (the declared wire schema, same contract as WIRE_COLUMNS for EXP
+# frames; the codec helpers below are the only writers/readers)
+REPLICA_WIRE_COLUMNS = (
+    "meta", "ok", "grad", "pidx", "ptd",            # submission
+    "status", "generation", "round", "members",     # reply control
+    "applied", "epoch_due", "wsrc", "wcount", "widx", "wtd")
+
+
+def _pack_round(replica: int, generation: int, round_idx: int, ok: bool,
+                grad: np.ndarray, pidx: Optional[np.ndarray] = None,
+                ptd: Optional[np.ndarray] = None) -> bytes:
+    cols = {
+        "meta": np.asarray([replica, generation, round_idx], np.int64),
+        "ok": np.asarray([1 if ok else 0], np.int64),
+        "grad": np.ascontiguousarray(grad, dtype=np.float32),
+    }
+    if pidx is not None and len(pidx):
+        cols["pidx"] = np.ascontiguousarray(pidx, dtype=np.int32)
+        cols["ptd"] = np.ascontiguousarray(ptd, dtype=np.float32)
+    out = io.BytesIO()
+    np.savez(out, **cols)
+    return out.getvalue()
+
+
+def _unpack_round(payload: bytes) -> dict:
+    try:
+        with np.load(io.BytesIO(payload)) as z:
+            cols = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise ConnectionError(f"unparseable RGRAD payload: {e!r}")
+    meta = cols.get("meta")
+    if meta is None or meta.shape != (3,) or meta.dtype.kind not in "iu":
+        raise ValueError("malformed RGRAD frame: bad meta column")
+    return cols
+
+
+def _pack_round_reply(status: int, generation: int = 0, round_idx: int = 0,
+                      grad: Optional[np.ndarray] = None,
+                      members: Tuple[int, ...] = (), applied: int = 0,
+                      epoch_due: bool = False,
+                      writebacks: Optional[List[Tuple[int, np.ndarray,
+                                                      np.ndarray]]] = None
+                      ) -> bytes:
+    cols = {
+        "status": np.asarray([status], np.int64),
+        "generation": np.asarray([generation], np.int64),
+        "round": np.asarray([round_idx], np.int64),
+        "members": np.asarray(list(members), np.int64),
+        "applied": np.asarray([applied], np.int64),
+        "epoch_due": np.asarray([1 if epoch_due else 0], np.int64),
+    }
+    if grad is not None:
+        cols["grad"] = np.ascontiguousarray(grad, dtype=np.float32)
+    if writebacks:
+        # merged |TD| write-backs, one group per contributing replica in
+        # the deterministic merge order: every replica applies ALL
+        # groups sequentially, so the N local PER rings stay one
+        # logical priority plane
+        cols["wsrc"] = np.asarray([s for s, _i, _t in writebacks],
+                                  np.int64)
+        cols["wcount"] = np.asarray([len(i) for _s, i, _t in writebacks],
+                                    np.int64)
+        cols["widx"] = np.concatenate(
+            [np.asarray(i, np.int32) for _s, i, _t in writebacks])
+        cols["wtd"] = np.concatenate(
+            [np.asarray(t, np.float32) for _s, _i, t in writebacks])
+    out = io.BytesIO()
+    np.savez(out, **cols)
+    return out.getvalue()
+
+
+def _unpack_round_reply(payload: bytes) -> dict:
+    try:
+        with np.load(io.BytesIO(payload)) as z:
+            cols = {k: z[k] for k in z.files}
+    except Exception as e:
+        raise ConnectionError(f"unparseable RGRAD reply: {e!r}")
+    out: Dict[str, Any] = {
+        "status": int(cols["status"][0]),
+        "generation": int(cols.get("generation", [0])[0]),
+        "round": int(cols.get("round", [0])[0]),
+        "members": [int(m) for m in cols.get("members", [])],
+        "applied": int(cols.get("applied", [0])[0]),
+        "epoch_due": bool(cols.get("epoch_due", [0])[0]),
+        "grad": cols.get("grad"),
+    }
+    wb: List[Tuple[int, np.ndarray, np.ndarray]] = []
+    if "wsrc" in cols and len(cols["wsrc"]):
+        off = 0
+        for s, n in zip(cols["wsrc"], cols["wcount"]):
+            wb.append((int(s), cols["widx"][off:off + int(n)],
+                       cols["wtd"][off:off + int(n)]))
+            off += int(n)
+    out["writebacks"] = wb
+    return out
+
+
+def _pack_prio(replica: int, generation: int, pidx: np.ndarray,
+               ptd: np.ndarray) -> bytes:
+    out = io.BytesIO()
+    np.savez(out,
+             meta=np.asarray([replica, generation], np.int64),
+             pidx=np.ascontiguousarray(pidx, dtype=np.int32),
+             ptd=np.ascontiguousarray(ptd, dtype=np.float32))
+    return out.getvalue()
+
+
+class ReplicaRegistry:
+    """Gateway-side membership + round coordinator for the elastic
+    multi-learner plane (ISSUE 15).
+
+    **Lease-fenced membership.**  Each replica holds a renewable lease
+    stamped with a monotonic GENERATION number (one counter across the
+    registry — every acquire, including a rejoin, consumes a fresh
+    generation, so generations totally order membership history).  A
+    lease neither renewed nor exercised (a round submission is proof of
+    life) within ``lease_s`` expires: the member is removed, counted,
+    and FENCED — any later gradient or priority write-back stamped with
+    its dead generation is a counted reject (``stale_grad_rejected`` /
+    ``stale_prio_rejected``), never applied.  A second acquire for the
+    same replica id with a HIGHER incarnation evicts the stale holder
+    (the double-lease case: a replacement process fencing its own
+    half-open predecessor — PR 1's slot fencing lifted to the learner
+    plane); equal/lower incarnations are refused.
+
+    **Fault-tolerant rounds.**  ``submit`` blocks until round ``r`` has
+    contributions from every live member whose ``joined_round <= r``.
+    Membership can shrink while waiting: expiry (dead renewer) or the
+    ROUND-STALL rule — once the first contribution lands, members still
+    silent after one lease window are expelled (this is how a HUNG
+    replica whose background renewer is still faithfully renewing gets
+    fenced: leases prove liveness, rounds prove progress).  The round
+    then completes over the surviving set: the reduced gradient is the
+    mean over the surviving contributions summed in ascending replica
+    order (a fixed fp32 reduction order, so an N=1 completion is
+    bit-identical to the solo learner's own gradient), and the merged
+    per-replica |TD| write-backs ride the reply in the same order so
+    every survivor applies the identical priority mutation sequence.
+
+    **Elastic rejoin.**  A mid-training acquire schedules a JOIN
+    BARRIER: the round before the joiner's entry round replies
+    ``epoch_due`` to every member (rank 0 commits a checkpoint epoch of
+    the post-round state — utils/checkpoint.save_epoch), survivors then
+    hold at the entry round until the joiner loads that exact epoch and
+    ``activate``s (or its ``join_timeout_s`` lapses and the join is
+    cancelled).  State convergence is by construction: the joiner
+    resumes the very bytes the survivors checkpointed.
+
+    Pure stdlib+numpy — no jax — so tools/chaos_soak.py drills the
+    whole plane in milliseconds."""
+
+    def __init__(self, params=None, writer=None):
+        self.params = resolve_replica(params)
+        self._cond = threading.Condition()
+        self._gen = 0
+        # replica -> {generation, incarnation, expires, joined_round,
+        #             round, renews, born, marks: [(mono, round)]}
+        self._members: Dict[int, Dict[str, Any]] = {}
+        # fenced generations: replica -> last dead generation (the
+        # last-generation-wins check reads the LIVE table; this map is
+        # observability for drills)
+        self._fenced_gen: Dict[int, int] = {}
+        self._rounds: Dict[int, Dict[str, Any]] = {}
+        self._round_done = -1
+        # replica -> {generation, join_round, deadline}
+        self._joining: Dict[int, Dict[str, Any]] = {}
+        self._epoch_due: Dict[int, bool] = {}   # round -> commit due
+        self._epoch_step: Dict[int, int] = {}   # round -> committed step
+        self._oob_writebacks: List[Tuple[int, np.ndarray,
+                                         np.ndarray]] = []
+        self._churn: List[float] = []  # walls of expiry/fence events
+        self._writer = writer
+        self._last_emit = 0.0
+        self._recorder = flight_recorder.get_recorder("replica-registry")
+        # counters (the drill ledger: chaos_soak asserts these EXACTLY)
+        self.leases_granted = 0
+        self.leases_expired = 0
+        self.leases_released = 0
+        self.lease_fenced = 0           # double-lease evictions
+        self.stale_grad_rejected = 0
+        self.stale_prio_rejected = 0
+        self.prio_merged_rows = 0
+        self.rounds_completed = 0
+        self.degraded_completions = 0   # completed over a shrunk set
+        self.joins_completed = 0
+        self.joins_timed_out = 0
+
+    # -- internals (all under self._cond) -----------------------------------
+
+    def _lease_window(self) -> float:
+        return max(0.05, float(self.params.lease_s))
+
+    def _emit_locked(self, force: bool = False) -> None:
+        """``replica/*`` scalar rows for mission control (ISSUE 10):
+        membership size, current generation, and generation churn
+        (lease-consuming events — expiries + fences — in the last 60 s)
+        — the series the ``replica_membership`` / ``replica_churn``
+        DEFAULT_RULES watch.  Rate-limited; event paths force."""
+        if self._writer is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_emit < 1.0:
+            return
+        self._last_emit = now
+        wall = time.time()
+        cutoff = wall - 60.0
+        self._churn = [w for w in self._churn if w >= cutoff]
+        try:
+            self._writer.scalar("replica/members",
+                                float(len(self._members)),
+                                step=self._round_done + 1, wall=wall)
+            self._writer.scalar("replica/generation", float(self._gen),
+                                step=self._round_done + 1, wall=wall)
+            self._writer.scalar("replica/generation_churn",
+                                float(len(self._churn)),
+                                step=self._round_done + 1, wall=wall)
+            self._writer.flush()
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+
+    def _note_churn_locked(self) -> None:
+        self._churn.append(time.time())
+
+    def _expire_locked(self, now: float, round_waiting: Optional[int] = None
+                       ) -> None:
+        """Expire dead leases; with ``round_waiting`` set, also apply
+        the round-stall rule to members blocking that round."""
+        stalled: List[int] = []
+        rnd = self._rounds.get(round_waiting) if round_waiting is not None \
+            else None
+        for rid, m in list(self._members.items()):
+            dead = now > m["expires"]
+            reason = "lease-expired"
+            if not dead and rnd is not None and not rnd["done"] \
+                    and m["joined_round"] <= round_waiting \
+                    and rid not in rnd["contribs"] \
+                    and rid not in self._joining \
+                    and now - rnd["first_at"] > self._lease_window():
+                # renewing but not progressing: a hung replica must not
+                # wedge the survivors — expelled within one lease window
+                dead, reason = True, "round-stall"
+            if not dead:
+                continue
+            del self._members[rid]
+            self._fenced_gen[rid] = m["generation"]
+            self._joining.pop(rid, None)
+            self.leases_expired += 1
+            self._note_churn_locked()
+            stalled.append(rid)
+            self._recorder.record("lease-expired", replica=rid,
+                                  generation=m["generation"],
+                                  reason=reason)
+            print(f"[replica] lease expired: replica {rid} "
+                  f"(generation {m['generation']}, {reason})", flush=True)
+        if stalled:
+            self._emit_locked(force=True)
+            self._cond.notify_all()
+        # cancel joins whose deadline lapsed (the joiner never loaded
+        # its barrier epoch): survivors must proceed
+        for rid, j in list(self._joining.items()):
+            if now > j["deadline"]:
+                del self._joining[rid]
+                m = self._members.pop(rid, None)
+                if m is not None:
+                    self._fenced_gen[rid] = m["generation"]
+                self.joins_timed_out += 1
+                self._note_churn_locked()
+                self._recorder.record("join-timeout", replica=rid)
+                self._emit_locked(force=True)
+                self._cond.notify_all()
+
+    def _live(self, rid: int, generation: int) -> bool:
+        m = self._members.get(rid)
+        return m is not None and m["generation"] == generation
+
+    def _required_locked(self, round_idx: int) -> Set[int]:
+        return {rid for rid, m in self._members.items()
+                if m["joined_round"] <= round_idx}
+
+    # -- lease verbs ---------------------------------------------------------
+
+    def acquire(self, replica: int, incarnation: int) -> dict:
+        with self._cond:
+            now = time.monotonic()
+            self._expire_locked(now)
+            held = self._members.get(replica)
+            if held is not None:
+                if incarnation <= held["incarnation"]:
+                    return {"status": "refused",
+                            "error": f"replica {replica} already leased "
+                                     f"(incarnation {incarnation} <= "
+                                     f"{held['incarnation']})"}
+                # double-lease: same slot, newer incarnation — fence the
+                # stale holder, the newer incarnation wins
+                self._fenced_gen[replica] = held["generation"]
+                self.lease_fenced += 1
+                self._note_churn_locked()
+                self._recorder.record("lease-fenced", replica=replica,
+                                      old=held["generation"])
+            self._gen += 1
+            g = self._gen
+            open_max = max(self._rounds.keys(), default=self._round_done)
+            fresh = self._round_done < 0 and not self._rounds
+            if fresh or not (self._members.keys() - {replica}):
+                joined = max(0, open_max + 1)
+                barrier = None
+            else:
+                # mid-training join: enter at J, with the round J-1
+                # completion carrying the epoch_due flag (rank 0
+                # commits the post-(J-1) state the joiner will load)
+                joined = open_max + 2
+                barrier = joined - 1
+                self._epoch_due[barrier] = True
+                self._joining[replica] = {
+                    "generation": g, "join_round": joined,
+                    "deadline": now + max(self.params.join_timeout_s,
+                                          self._lease_window())}
+            self._members[replica] = {
+                "generation": g, "incarnation": int(incarnation),
+                "expires": now + self._lease_window(),
+                "joined_round": joined, "round": joined - 1,
+                "renews": 0, "born": now,
+                "marks": [(now, joined - 1)]}
+            self.leases_granted += 1
+            self._recorder.record("lease-granted", replica=replica,
+                                  generation=g, joined_round=joined)
+            self._emit_locked(force=True)
+            self._cond.notify_all()
+            return {"status": "ok", "generation": g,
+                    "lease_s": self._lease_window(), "round": joined,
+                    "members": sorted(self._members),
+                    "epoch_barrier": barrier}
+
+    def renew(self, replica: int, generation: int,
+              round_idx: Optional[int] = None) -> dict:
+        with self._cond:
+            now = time.monotonic()
+            self._expire_locked(now)
+            if not self._live(replica, generation):
+                return {"status": "expired"}
+            m = self._members[replica]
+            m["expires"] = now + self._lease_window()
+            m["renews"] += 1
+            if round_idx is not None:
+                m["round"] = max(m["round"], int(round_idx))
+                m["marks"].append((now, m["round"]))
+                del m["marks"][:-8]
+            self._emit_locked()
+            reply = {"status": "ok", "generation": generation,
+                     "members": sorted(self._members)}
+            j = self._joining.get(replica)
+            if j is not None:
+                reply["join"] = {
+                    "round": j["join_round"],
+                    "epoch_round": j["join_round"] - 1,
+                    "epoch_step": self._epoch_step.get(
+                        j["join_round"] - 1)}
+            return reply
+
+    def release(self, replica: int, generation: int) -> dict:
+        with self._cond:
+            if self._live(replica, generation):
+                m = self._members.pop(replica)
+                self._fenced_gen[replica] = m["generation"]
+                self._joining.pop(replica, None)
+                self.leases_released += 1
+                self._recorder.record("lease-released", replica=replica,
+                                      generation=generation)
+                self._emit_locked(force=True)
+                self._cond.notify_all()
+            return {"status": "ok"}
+
+    def activate(self, replica: int, generation: int,
+                 epoch_step: Optional[int] = None) -> dict:
+        """A rejoiner confirms it loaded the barrier epoch: it becomes a
+        full member of its join round and the held survivors proceed."""
+        with self._cond:
+            if not self._live(replica, generation):
+                return {"status": "expired"}
+            j = self._joining.pop(replica, None)
+            if j is not None:
+                self.joins_completed += 1
+                self._recorder.record("join-activated", replica=replica,
+                                      generation=generation,
+                                      epoch_step=epoch_step)
+            m = self._members[replica]
+            now = time.monotonic()
+            m["expires"] = now + self._lease_window()
+            # restart the entry round's stall clock: the survivors'
+            # submissions set first_at while the joiner was still
+            # loading the epoch — without this reset, a first-round jit
+            # compile longer than one lease window would expel the
+            # freshly-activated joiner under the round-stall rule
+            rnd = self._rounds.get(m["joined_round"])
+            if rnd is not None and not rnd["done"]:
+                rnd["first_at"] = now
+            self._emit_locked(force=True)
+            self._cond.notify_all()
+            return {"status": "ok", "round": m["joined_round"],
+                    "members": sorted(self._members)}
+
+    def note_epoch(self, replica: int, generation: int, round_idx: int,
+                   step: int) -> dict:
+        """Rank 0 reports the barrier epoch committed at ``step`` —
+        the signal a pending joiner polls for (via ``renew``)."""
+        with self._cond:
+            if not self._live(replica, generation):
+                return {"status": "expired"}
+            self._epoch_step[round_idx] = int(step)
+            self._epoch_due.pop(round_idx, None)
+            self._recorder.record("epoch-committed", round=round_idx,
+                                  step=step, by=replica)
+            self._cond.notify_all()
+            return {"status": "ok"}
+
+    # -- the generation-stamped allreduce round ------------------------------
+
+    def submit(self, replica: int, generation: int, round_idx: int,
+               grad: np.ndarray, ok: bool = True,
+               pidx: Optional[np.ndarray] = None,
+               ptd: Optional[np.ndarray] = None) -> dict:
+        """One blocking round contribution; returns the completed
+        round's result (or a fenced/stale/timeout status).  The caller's
+        serve thread (or the local channel's caller) parks on the
+        registry condition; submitting and waiting both count as proof
+        of life, so a member blocked on a slow peer is never expired —
+        the PEER is, by the round-stall rule."""
+        deadline_s = self.params.round_timeout_s or \
+            (3.0 * self._lease_window() + 1.0)
+        with self._cond:
+            now = time.monotonic()
+            self._expire_locked(now)
+            done = self._rounds.get(round_idx)
+            if done is not None and done["done"] \
+                    and replica in done["contribs"] \
+                    and done["contribs"][replica][0] == generation:
+                # idempotent retransmit: this replica already completed
+                # this round and its reply ack was lost to a wire blip
+                # — hand the retained result back instead of fencing a
+                # perfectly live member for retrying
+                return done["result"]
+            if round_idx <= self._round_done \
+                    or (not self._live(replica, generation)):
+                stale = not self._live(replica, generation)
+                self.stale_grad_rejected += 1
+                self._recorder.record("stale-grad-rejected",
+                                      replica=replica,
+                                      generation=generation,
+                                      round=round_idx)
+                return {"status": (RSTAT_FENCED if stale
+                                   else RSTAT_STALE)}
+            rnd = self._rounds.get(round_idx)
+            if rnd is None:
+                rnd = self._rounds[round_idx] = {
+                    "contribs": {}, "first_at": now, "done": False,
+                    "result": None,
+                    "starting_members": len(self._required_locked(
+                        round_idx))}
+            rnd["contribs"][replica] = (
+                generation, bool(ok),
+                np.ascontiguousarray(grad, dtype=np.float32),
+                (None if pidx is None or not len(pidx)
+                 else (np.ascontiguousarray(pidx, np.int32),
+                       np.ascontiguousarray(ptd, np.float32))))
+            m = self._members[replica]
+            m["round"] = max(m["round"], round_idx)
+            m["marks"].append((now, round_idx))
+            del m["marks"][:-8]
+            self._cond.notify_all()
+            deadline = now + deadline_s
+            while True:
+                now = time.monotonic()
+                # waiting in a round is progress: refresh my own lease
+                me = self._members.get(replica)
+                if me is None or me["generation"] != generation:
+                    # fenced while waiting (double-lease eviction)
+                    return {"status": RSTAT_FENCED}
+                me["expires"] = now + self._lease_window()
+                self._expire_locked(now, round_waiting=round_idx)
+                if rnd["done"]:
+                    return rnd["result"]
+                self._try_complete_locked(round_idx)
+                if rnd["done"]:
+                    return rnd["result"]
+                # a PENDING joiner legitimately stretches its entry
+                # round past the normal wait (it is loading the barrier
+                # epoch, bounded by its own join deadline) — survivors
+                # must hold for it, not time out under it
+                eff = deadline
+                for j in self._joining.values():
+                    if j["join_round"] <= round_idx:
+                        eff = max(eff, j["deadline"] + 1.0)
+                if now > eff:
+                    return {"status": RSTAT_TIMEOUT}
+                self._cond.wait(0.05)
+
+    def _try_complete_locked(self, round_idx: int) -> None:
+        rnd = self._rounds.get(round_idx)
+        if rnd is None or rnd["done"]:
+            return
+        required = self._required_locked(round_idx)
+        if not required:
+            return
+        # only contributions from members STILL live at completion time
+        # count (a contributor that died mid-round is dropped from the
+        # reduce — its generation is fenced, its gradient with it)
+        have = {rid for rid in rnd["contribs"]
+                if self._live(rid, rnd["contribs"][rid][0])}
+        if not required <= have:
+            return
+        ids = sorted(required)
+        valid = [rid for rid in ids if rnd["contribs"][rid][1]]
+        reduced = None
+        if valid:
+            # fixed fp32 reduction order (ascending replica id): at
+            # N=1 the "mean" is grad / 1.0 — bit-identical to the solo
+            # learner's own gradient, the degraded-parity contract
+            acc = rnd["contribs"][valid[0]][2].astype(np.float32,
+                                                      copy=True)
+            for rid in valid[1:]:
+                acc += rnd["contribs"][rid][2]
+            reduced = acc / np.float32(len(valid))
+        writebacks = [(rid,) + rnd["contribs"][rid][3]
+                      for rid in valid
+                      if rnd["contribs"][rid][3] is not None]
+        if self._oob_writebacks:
+            # fenced-validated out-of-round merges land AFTER the
+            # in-round groups, in arrival order — identically on every
+            # member, so the logical priority plane never forks
+            writebacks.extend(self._oob_writebacks)
+            self._oob_writebacks = []
+        rnd["result"] = {
+            "status": RSTAT_OK,
+            "grad": reduced,
+            "applied": len(valid),
+            "members": list(ids),
+            "round": round_idx,
+            "epoch_due": bool(self._epoch_due.get(round_idx)),
+            "writebacks": writebacks,
+        }
+        rnd["done"] = True
+        self._round_done = max(self._round_done, round_idx)
+        self.rounds_completed += 1
+        if len(ids) < rnd["starting_members"]:
+            self.degraded_completions += 1
+            self._recorder.record("round-degraded", round=round_idx,
+                                  survivors=ids,
+                                  started=rnd["starting_members"])
+        # retire old round state (completed results are only read by
+        # waiters already parked on them; keep a couple for stragglers)
+        for r in [r for r in self._rounds if r < round_idx - 2]:
+            del self._rounds[r]
+        self._emit_locked()
+        self._cond.notify_all()
+
+    def merge_prio(self, replica: int, generation: int, pidx: np.ndarray,
+                   ptd: np.ndarray) -> dict:
+        """Out-of-round |TD| write-back merge with last-generation-wins
+        fencing: live-generation writes queue for the next round's
+        merged reply; a zombie's stale-generation write is a counted
+        reject and never touches the priority plane."""
+        with self._cond:
+            self._expire_locked(time.monotonic())
+            if not self._live(replica, generation):
+                self.stale_prio_rejected += 1
+                self._recorder.record("stale-prio-rejected",
+                                      replica=replica,
+                                      generation=generation,
+                                      rows=int(len(pidx)))
+                return {"status": "stale"}
+            self._oob_writebacks.append(
+                (replica, np.ascontiguousarray(pidx, np.int32),
+                 np.ascontiguousarray(ptd, np.float32)))
+            self.prio_merged_rows += int(len(pidx))
+            return {"status": "ok"}
+
+    # -- observability -------------------------------------------------------
+
+    def status_block(self) -> dict:
+        """The gateway STATUS ``replicas`` block: membership with lease
+        ages + per-replica round rates, the generation counter, and the
+        fencing/round ledger — tools/fleet_top.py's replicas panel and
+        the chaos drills' exact-counter verdicts both read this."""
+        with self._cond:
+            now = time.monotonic()
+            members = {}
+            for rid, m in self._members.items():
+                rate = None
+                marks = m["marks"]
+                if len(marks) >= 2 and marks[-1][0] > marks[0][0] + 0.2:
+                    rate = round((marks[-1][1] - marks[0][1])
+                                 / (marks[-1][0] - marks[0][0]), 2)
+                members[str(rid)] = {
+                    "generation": m["generation"],
+                    "lease_age": round(
+                        max(0.0, now - (m["expires"]
+                                        - self._lease_window())), 3),
+                    "round": m["round"],
+                    "renews": m["renews"],
+                    "joining": rid in self._joining,
+                    "updates_per_s": rate,
+                }
+            expected = max(1, int(self.params.replicas))
+            return {
+                "expected": expected,
+                "members": members,
+                "degraded": len(members) < expected,
+                "generation": self._gen,
+                "rounds_completed": self.rounds_completed,
+                "degraded_completions": self.degraded_completions,
+                "counters": {
+                    "leases_granted": self.leases_granted,
+                    "leases_expired": self.leases_expired,
+                    "leases_released": self.leases_released,
+                    "lease_fenced": self.lease_fenced,
+                    "stale_grad_rejected": self.stale_grad_rejected,
+                    "stale_prio_rejected": self.stale_prio_rejected,
+                    "prio_merged_rows": self.prio_merged_rows,
+                    "joins_completed": self.joins_completed,
+                    "joins_timed_out": self.joins_timed_out,
+                },
+            }
+
+    # -- wire dispatch (called by DcnGateway serve threads) ------------------
+
+    def handle_lease(self, msg: dict) -> dict:
+        action = str(msg.get("action", ""))
+        try:
+            rid = int(msg.get("replica"))
+        except (TypeError, ValueError):
+            return {"status": "error", "error": "bad replica id"}
+        if action == "acquire":
+            return self.acquire(rid, int(msg.get("incarnation", 0)))
+        gen = int(msg.get("generation", -1))
+        if action == "renew":
+            r = msg.get("round")
+            return self.renew(rid, gen,
+                              int(r) if r is not None else None)
+        if action == "release":
+            return self.release(rid, gen)
+        if action == "activate":
+            es = msg.get("epoch_step")
+            return self.activate(rid, gen,
+                                 int(es) if es is not None else None)
+        if action == "epoch":
+            return self.note_epoch(rid, gen, int(msg.get("round", -1)),
+                                   int(msg.get("step", -1)))
+        return {"status": "error", "error": f"unknown action {action!r}"}
+
+    def handle_round(self, payload: bytes) -> bytes:
+        try:
+            cols = _unpack_round(payload)
+        except ValueError:
+            return _pack_round_reply(RSTAT_STALE)  # malformed: reject
+        rid, gen, rnd = (int(x) for x in cols["meta"])
+        pidx, ptd = cols.get("pidx"), cols.get("ptd")
+        res = self.submit(rid, gen, rnd, cols.get(
+            "grad", np.zeros(0, np.float32)),
+            ok=bool(cols.get("ok", [1])[0]),
+            pidx=pidx, ptd=ptd)
+        if res["status"] != RSTAT_OK:
+            return _pack_round_reply(res["status"])
+        return _pack_round_reply(
+            RSTAT_OK, generation=gen, round_idx=res["round"],
+            grad=res["grad"], members=res["members"],
+            applied=res["applied"], epoch_due=res["epoch_due"],
+            writebacks=res["writebacks"])
+
+    def handle_prio(self, payload: bytes) -> dict:
+        try:
+            with np.load(io.BytesIO(payload)) as z:
+                meta = z["meta"]
+                pidx = z["pidx"]
+                ptd = z["ptd"]
+        except Exception as e:
+            raise ConnectionError(f"unparseable RPRIO payload: {e!r}")
+        return self.merge_prio(int(meta[0]), int(meta[1]), pidx, ptd)
+
+
+class ReplicaFenced(RuntimeError):
+    """This replica's lease is gone (expired, superseded, or the round
+    reply said fenced): its generation can no longer write anything.
+    The driver's recovery is rejoin-at-a-new-generation or a nonzero
+    exit for the supervisor — never a silent continue."""
+
+
+class LocalReplicaChannel:
+    """In-process channel to a ReplicaRegistry — the lead learner runs
+    in the gateway's own process, so its replica-plane traffic skips
+    the wire (same surface as ReplicaClient; tests use it too)."""
+
+    def __init__(self, registry: ReplicaRegistry, replica: int,
+                 incarnation: Optional[int] = None):
+        self.registry = registry
+        self.replica = replica
+        self.incarnation = (int(incarnation) if incarnation is not None
+                            else time.time_ns() // 1_000_000)
+        self.generation: Optional[int] = None
+        self._granted_lease_s: Optional[float] = None
+        self.fenced = threading.Event()
+        self._renew_stop = threading.Event()
+        self._renew_thread: Optional[threading.Thread] = None
+        self._round = 0  # last round index reported on renews
+
+    # -- surface shared with ReplicaClient -----------------------------------
+
+    def acquire(self) -> dict:
+        self.incarnation += 1
+        reply = self.registry.acquire(self.replica, self.incarnation)
+        if reply.get("status") != "ok":
+            raise ReplicaFenced(
+                f"replica {self.replica} lease refused: "
+                f"{reply.get('error')}")
+        self.generation = reply["generation"]
+        # the renew cadence follows the SERVER'S lease window (it rides
+        # the acquire reply): a client configured with a longer window
+        # than the registry's would otherwise expire between renews
+        self._granted_lease_s = float(reply.get("lease_s", 0.0)) or None
+        self.fenced.clear()
+        return reply
+
+    def renew(self) -> dict:
+        if self.generation is None:
+            return {"status": "expired"}
+        reply = self.registry.renew(self.replica, self.generation,
+                                    self._round)
+        if reply.get("status") != "ok":
+            self.fenced.set()
+        return reply
+
+    def start_renewer(self, period: Optional[float] = None) -> None:
+        if self._renew_thread is not None \
+                and self._renew_thread.is_alive():
+            return
+        self._renew_stop.clear()
+        p = period or (self.registry.params.renew_s
+                       or (self._granted_lease_s
+                           or self.registry._lease_window()) / 3.0)
+
+        def _loop() -> None:
+            while not self._renew_stop.wait(p):
+                if self.fenced.is_set():
+                    return
+                self.renew()
+
+        self._renew_thread = threading.Thread(
+            target=_loop, name=f"replica-renew-{self.replica}",
+            daemon=True)
+        self._renew_thread.start()
+
+    def submit_round(self, round_idx: int, grad: np.ndarray,
+                     ok: bool = True,
+                     pidx: Optional[np.ndarray] = None,
+                     ptd: Optional[np.ndarray] = None) -> dict:
+        if self.generation is None:
+            raise ReplicaFenced(f"replica {self.replica} has no lease")
+        self._round = round_idx
+        res = self.registry.submit(self.replica, self.generation,
+                                   round_idx, grad, ok=ok,
+                                   pidx=pidx, ptd=ptd)
+        if res["status"] in (RSTAT_FENCED, RSTAT_STALE):
+            self.fenced.set()
+        return res
+
+    def merge_prio(self, pidx: np.ndarray, ptd: np.ndarray,
+                   generation: Optional[int] = None) -> dict:
+        g = self.generation if generation is None else generation
+        if g is None:
+            raise ReplicaFenced(f"replica {self.replica} has no lease")
+        return self.registry.merge_prio(self.replica, g, pidx, ptd)
+
+    def note_epoch(self, round_idx: int, step: int) -> dict:
+        return self.registry.note_epoch(self.replica, self.generation,
+                                        round_idx, step)
+
+    def activate(self, epoch_step: Optional[int] = None) -> dict:
+        return self.registry.activate(self.replica, self.generation,
+                                      epoch_step)
+
+    def members(self) -> List[int]:
+        reply = self.renew()
+        return list(reply.get("members", []))
+
+    def wait_members(self, n: int, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.members()) >= n:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def poll_join(self) -> Optional[dict]:
+        return self.renew().get("join")
+
+    def release(self) -> None:
+        if self.generation is not None and not self.fenced.is_set():
+            self.registry.release(self.replica, self.generation)
+
+    def close(self) -> None:
+        self._renew_stop.set()
+        if self._renew_thread is not None:
+            self._renew_thread.join(2.0)
+            self._renew_thread = None
+
+
+class ReplicaClient:
+    """Wire twin of LocalReplicaChannel: one replica host's connection
+    to the lead gateway's replica plane.  Two sockets — a control
+    connection for the lease verbs (sessionless-adjacent: cheap JSON
+    RPCs that must keep flowing while a round blocks) and a round
+    connection whose T_RGRAD request parks server-side until the round
+    completes.  Transport errors surface as ReplicaFenced after one
+    redial attempt: the replica plane's recovery story is leases and
+    rejoin, not transparent session resumption — a replica that cannot
+    reach the registry for a lease window IS expired."""
+
+    def __init__(self, address: Tuple[str, int], replica: int,
+                 params=None, incarnation: Optional[int] = None):
+        self.address = address
+        self.replica = replica
+        self.params = resolve_replica(params)
+        self.incarnation = (int(incarnation) if incarnation is not None
+                            else time.time_ns() // 1_000_000)
+        self.generation: Optional[int] = None
+        self._granted_lease_s: Optional[float] = None
+        self.fenced = threading.Event()
+        self._lease_lock = threading.Lock()
+        self._round_lock = threading.Lock()
+        self._lease_sock: Optional[socket.socket] = None
+        self._round_sock: Optional[socket.socket] = None
+        self._renew_stop = threading.Event()
+        self._renew_thread: Optional[threading.Thread] = None
+        self._round = 0
+
+    def _lease_window(self) -> float:
+        return max(0.05, float(self.params.lease_s))
+
+    def _rpc(self, which: str, ftype: int, payload: bytes,
+             timeout: float) -> Tuple[int, bytes]:
+        lock = self._lease_lock if which == "lease" else self._round_lock
+        attr = "_lease_sock" if which == "lease" else "_round_sock"
+        with lock:
+            for attempt in (0, 1):
+                sock = getattr(self, attr)
+                try:
+                    if sock is None:
+                        sock = socket.create_connection(
+                            self.address, timeout=5.0)
+                        sock.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                        setattr(self, attr, sock)
+                    sock.settimeout(timeout)
+                    _send_frame(sock, ftype, payload)
+                    return _recv_frame(sock)
+                except (ConnectionError, OSError):
+                    try:
+                        if sock is not None:
+                            sock.close()
+                    except OSError:
+                        pass
+                    setattr(self, attr, None)
+                    if attempt:
+                        raise
+
+    def _lease_rpc(self, msg: dict,
+                   timeout: Optional[float] = None) -> dict:
+        rtype, payload = self._rpc(
+            "lease", T_RLEASE, json.dumps(msg).encode(),
+            timeout or max(5.0, self._lease_window()))
+        if rtype != T_RLEASE:
+            raise ConnectionError(
+                f"expected T_RLEASE reply, got frame type {rtype}")
+        try:
+            return json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ConnectionError(f"undecodable RLEASE reply: {e}")
+
+    # -- surface (mirrors LocalReplicaChannel) -------------------------------
+
+    def acquire(self) -> dict:
+        self.incarnation += 1
+        reply = self._lease_rpc({"action": "acquire",
+                                 "replica": self.replica,
+                                 "incarnation": self.incarnation})
+        if reply.get("status") != "ok":
+            raise ReplicaFenced(
+                f"replica {self.replica} lease refused: "
+                f"{reply.get('error')}")
+        self.generation = reply["generation"]
+        # the renew cadence follows the SERVER'S lease window (it rides
+        # the acquire reply): a client configured with a longer window
+        # than the registry's would otherwise expire between renews
+        self._granted_lease_s = float(reply.get("lease_s", 0.0)) or None
+        self.fenced.clear()
+        return reply
+
+    def renew(self) -> dict:
+        if self.generation is None:
+            return {"status": "expired"}
+        try:
+            reply = self._lease_rpc({"action": "renew",
+                                     "replica": self.replica,
+                                     "generation": self.generation,
+                                     "round": self._round})
+        except (ConnectionError, OSError):
+            return {"status": "error"}
+        if reply.get("status") == "expired":
+            self.fenced.set()
+        return reply
+
+    def start_renewer(self, period: Optional[float] = None) -> None:
+        if self._renew_thread is not None \
+                and self._renew_thread.is_alive():
+            return
+        self._renew_stop.clear()
+        p = period or (self.params.renew_s
+                       or (self._granted_lease_s
+                           or self._lease_window()) / 3.0)
+
+        def _loop() -> None:
+            while not self._renew_stop.wait(p):
+                if self.fenced.is_set():
+                    return
+                self.renew()
+
+        self._renew_thread = threading.Thread(
+            target=_loop, name=f"replica-renew-{self.replica}",
+            daemon=True)
+        self._renew_thread.start()
+
+    def submit_round(self, round_idx: int, grad: np.ndarray,
+                     ok: bool = True,
+                     pidx: Optional[np.ndarray] = None,
+                     ptd: Optional[np.ndarray] = None) -> dict:
+        if self.generation is None:
+            raise ReplicaFenced(f"replica {self.replica} has no lease")
+        self._round = round_idx
+        timeout = (self.params.round_timeout_s
+                   or 3.0 * self._lease_window() + 1.0) + 10.0
+        rtype, payload = self._rpc(
+            "round", T_RGRAD,
+            _pack_round(self.replica, self.generation, round_idx, ok,
+                        grad, pidx, ptd),
+            timeout)
+        if rtype != T_RGRAD:
+            raise ConnectionError(
+                f"expected T_RGRAD reply, got frame type {rtype}")
+        res = _unpack_round_reply(payload)
+        if res["status"] in (RSTAT_FENCED, RSTAT_STALE):
+            self.fenced.set()
+        return res
+
+    def merge_prio(self, pidx: np.ndarray, ptd: np.ndarray,
+                   generation: Optional[int] = None) -> dict:
+        g = self.generation if generation is None else generation
+        if g is None:
+            raise ReplicaFenced(f"replica {self.replica} has no lease")
+        rtype, payload = self._rpc(
+            "lease", T_RPRIO, _pack_prio(self.replica, g, pidx, ptd),
+            max(5.0, self._lease_window()))
+        if rtype != T_RPRIO:
+            raise ConnectionError(
+                f"expected T_RPRIO reply, got frame type {rtype}")
+        return json.loads(payload.decode())
+
+    def note_epoch(self, round_idx: int, step: int) -> dict:
+        return self._lease_rpc({"action": "epoch",
+                                "replica": self.replica,
+                                "generation": self.generation,
+                                "round": round_idx, "step": step})
+
+    def activate(self, epoch_step: Optional[int] = None) -> dict:
+        return self._lease_rpc({"action": "activate",
+                                "replica": self.replica,
+                                "generation": self.generation,
+                                "epoch_step": epoch_step})
+
+    def members(self) -> List[int]:
+        return list(self.renew().get("members", []))
+
+    def wait_members(self, n: int, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.members()) >= n:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def poll_join(self) -> Optional[dict]:
+        return self.renew().get("join")
+
+    def release(self) -> None:
+        if self.generation is None or self.fenced.is_set():
+            return
+        try:
+            self._lease_rpc({"action": "release",
+                             "replica": self.replica,
+                             "generation": self.generation})
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        self._renew_stop.set()
+        if self._renew_thread is not None:
+            self._renew_thread.join(2.0)
+            self._renew_thread = None
+        for attr in ("_lease_sock", "_round_sock"):
+            sock = getattr(self, attr)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                setattr(self, attr, None)
+
+
+# ---------------------------------------------------------------------------
 # learner-host gateway
 # ---------------------------------------------------------------------------
 
@@ -319,7 +1438,8 @@ class DcnGateway:
                  metrics_sink: Optional[Callable[[dict], int]] = None,
                  flow_params=None,
                  pressure: Optional[Callable[[], float]] = None,
-                 flow_writer=None):
+                 flow_writer=None,
+                 replicas: Optional[ReplicaRegistry] = None):
         self.param_store = param_store
         self.clock = clock
         self.actor_stats = actor_stats
@@ -346,6 +1466,11 @@ class DcnGateway:
         self._metrics_sink = metrics_sink
         self.metrics_batches = 0
         self.metrics_rows = 0
+        # replica plane (ISSUE 15): the lease-fenced membership registry
+        # + gradient-exchange coordinator for N data-parallel learner
+        # replicas.  None on non-replicated fleets — the verbs then
+        # answer counted errors, never crash a serve thread.
+        self._replicas = replicas
         self._tracer = tracing.get_tracer("gateway")
         self._recorder = flight_recorder.get_recorder("gateway")
         # flow-control plane (ISSUE 11, utils/flow.py): per-slot credit
@@ -481,6 +1606,11 @@ class DcnGateway:
             # conservation ledger — fleet_top's ``flow:`` panel line
             snap["flow"] = self._flow.status_block(
                 quarantined=sum(snap["quarantined"].values()))
+        if self._replicas is not None:
+            # replica plane (ISSUE 15): membership/generation/lease ages
+            # + the fencing ledger — fleet_top's ``replicas:`` panel
+            # line and the chaos drills' exact-counter verdicts
+            snap["replicas"] = self._replicas.status_block()
         if self._health is not None:
             try:
                 snap.update(self._health() or {})
@@ -605,12 +1735,16 @@ class DcnGateway:
             with conn:
                 while not self._stop.is_set():
                     ftype, payload = _recv_frame(conn)
-                    if ftype not in (T_STATUS, T_PROFILE, T_METRICS):
-                        # STATUS/PROFILE/METRICS probes are outside the
-                        # fault plane: a monitor polling the gateway
-                        # must neither shift a deterministic drill's
-                        # frame schedule nor absorb a fault meant for
-                        # session traffic
+                    if ftype not in (T_STATUS, T_PROFILE, T_METRICS,
+                                     T_RLEASE, T_RGRAD, T_RPRIO):
+                        # STATUS/PROFILE/METRICS probes and the replica
+                        # plane are outside the wire fault plane: a
+                        # monitor polling the gateway must neither shift
+                        # a deterministic drill's frame schedule nor
+                        # absorb a fault meant for session traffic, and
+                        # replica drills inject at the replica driver
+                        # (REPLICA_FAULTS) where kill/hang/crash are the
+                        # real failure modes
                         payload = self._faults.frame(payload)
                     if slot is not None:
                         # plain GIL-atomic write: heartbeat-age reads in
@@ -678,6 +1812,49 @@ class DcnGateway:
                             # competing with the experience plane.
                             reply["brownout"] = self._flow.governor.tier
                         _send_frame(conn, T_METRICS,
+                                    json.dumps(reply).encode())
+                    elif ftype == T_RLEASE:
+                        # replica lease verbs (ISSUE 15), sessionless-
+                        # adjacent like STATUS: no actor-slot claim —
+                        # the lease TABLE is the membership
+                        msg = self._json(payload) if payload else {}
+                        if self._replicas is None:
+                            reply = {"status": "error",
+                                     "error": "no replica registry "
+                                              "wired on this gateway"}
+                        else:
+                            try:
+                                reply = self._replicas.handle_lease(msg)
+                            except Exception as e:  # noqa: BLE001
+                                reply = {"status": "error",
+                                         "error": f"registry failed: "
+                                                  f"{e!r}"}
+                        _send_frame(conn, T_RLEASE,
+                                    json.dumps(reply).encode())
+                    elif ftype == T_RGRAD:
+                        # the generation-stamped allreduce round:
+                        # blocking THIS serve thread until the round
+                        # completes (or fences) is free concurrency-wise
+                        # — one thread per connection, and the registry
+                        # bounds the wait with the round-stall rule
+                        if self._replicas is None:
+                            _send_frame(conn, T_RGRAD,
+                                        _pack_round_reply(RSTAT_NOREG))
+                        else:
+                            _send_frame(conn, T_RGRAD,
+                                        self._replicas.handle_round(
+                                            payload))
+                    elif ftype == T_RPRIO:
+                        # out-of-round |TD| write-back merge with
+                        # last-generation-wins fencing (the zombie
+                        # replica's writes die HERE, counted)
+                        if self._replicas is None:
+                            reply = {"status": "error",
+                                     "error": "no replica registry "
+                                              "wired on this gateway"}
+                        else:
+                            reply = self._replicas.handle_prio(payload)
+                        _send_frame(conn, T_RPRIO,
                                     json.dumps(reply).encode())
                     elif ftype == T_EXP:
                         try:
@@ -987,6 +2164,22 @@ def push_metrics(address: Tuple[str, int], rows: list,
 # actor-host client + adapters
 # ---------------------------------------------------------------------------
 
+def redial_backoff(rng, prev: float, cap: float = 1.0,
+                   base: float = 0.05) -> float:
+    """Decorrelated-jitter backoff (the AWS 'decorrelated jitter'
+    scheme): next delay is uniform in ``[base, prev * 3]``, capped.
+    Drawn from the CLIENT'S OWN seeded RNG stream — the fix for the
+    reconnect thundering herd: the old deterministic doubling gave
+    every client the identical redial schedule, so N replicas killed
+    by one fault redialled the gateway in lockstep.  Seeding by slot
+    keeps seeded ``DCN_FAULTS`` drills reproducible (the schedule is a
+    pure function of the slot, not of wall clock) while two clients
+    with different slots spread their redial times
+    (tests/test_replicas.py asserts both properties)."""
+    hi = max(prev * 3.0, base * 1.001)
+    return float(min(cap, rng.uniform(base, hi)))
+
+
 class DcnDisconnected(ConnectionError):
     """Terminal session loss: the reconnect budget is spent (or the
     client is closing).  Subclasses ConnectionError so transport-level
@@ -1085,6 +2278,11 @@ class DcnClient:
             if reconnect_timeout is None else reconnect_timeout)
         self._recorder = flight_recorder.get_recorder(
             f"dcn-client-{process_ind}")
+        # slot-seeded redial jitter stream (see redial_backoff): each
+        # slot's backoff schedule is deterministic in isolation but
+        # decorrelated from its neighbours', so a mass disconnect never
+        # redials the gateway in lockstep
+        self._redial_rng = np.random.default_rng((0xDC2, process_ind))
         self._last_rpc = time.monotonic()
         deadline = time.monotonic() + connect_timeout
         delay = 0.1
@@ -1206,7 +2404,7 @@ class DcnClient:
                     self.address, timeout=max(0.1, min(5.0, remaining)))
             except OSError:
                 time.sleep(min(delay, max(0.0, remaining)))
-                delay = min(delay * 2, 1.0)
+                delay = redial_backoff(self._redial_rng, delay)
                 continue
             # the HELLO exchange is budgeted by the reconnect deadline,
             # not the (much longer) reply deadline: a frozen gateway whose
@@ -1227,7 +2425,7 @@ class DcnClient:
                 except OSError:
                     pass
                 time.sleep(min(delay, max(0.0, remaining)))
-                delay = min(delay * 2, 1.0)
+                delay = redial_backoff(self._redial_rng, delay)
                 continue
             self._configure(sock)  # restore the steady-state reply deadline
             self._sock = sock

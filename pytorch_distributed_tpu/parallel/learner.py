@@ -104,3 +104,54 @@ class ShardedLearner:
         rendezvous (and serialises the TPU pipeline).
         """
         return jax.device_get(state.params)
+
+
+class ReplicaExchange:
+    """Cross-host twin of the in-host dp ``psum`` above (ISSUE 15): the
+    glue between the jitted grad/apply split
+    (ops/losses.build_dqn_grad_and_apply) and the DCN replica channel
+    (parallel/dcn.py ReplicaRegistry / ReplicaClient).
+
+    Two-tier reduction story: WITHIN a host, gradients all-reduce over
+    ICI inside the jitted step — ``ShardedLearner`` stays the fast path
+    and nothing here touches it.  ACROSS hosts, the replica driver
+    (agents/learner.py) ravels the (already ICI-reduced) gradient
+    pytree to one fp32 vector, submits it as a generation-stamped round
+    through the gateway, and unravels the survivors' mean back.  The
+    ravel template is captured from the first local gradient, so the
+    exchange needs no a-priori knowledge of the param tree."""
+
+    def __init__(self, channel):
+        self.channel = channel
+        self.rounds = 0
+        self.degraded_rounds = 0
+        self.last_members: list = []
+
+    def exchange(self, round_idx: int, grads, ok: bool = True,
+                 pidx=None, ptd=None) -> tuple:
+        """One allreduce round: returns ``(reply, reduced_grads)`` —
+        ``reduced_grads`` is None when the round applied nothing (all
+        contributions non-finite: the skipped-step case).  Fenced/stale/
+        timeout statuses are returned in ``reply`` for the driver to
+        classify (rejoin vs exit); this layer only moves bytes."""
+        import numpy as np
+        from jax.flatten_util import ravel_pytree
+
+        host_grads = jax.device_get(grads)
+        flat, unravel = ravel_pytree(host_grads)
+        reply = self.channel.submit_round(
+            round_idx, np.asarray(flat, dtype=np.float32), ok=ok,
+            pidx=pidx, ptd=ptd)
+        from pytorch_distributed_tpu.parallel.dcn import RSTAT_OK
+
+        if reply["status"] != RSTAT_OK:
+            return reply, None
+        self.rounds += 1
+        members = list(reply.get("members", []))
+        if self.last_members and len(members) < len(self.last_members):
+            self.degraded_rounds += 1
+        self.last_members = members
+        if reply.get("applied", 0) <= 0 or reply.get("grad") is None:
+            return reply, None
+        return reply, unravel(np.asarray(reply["grad"],
+                                         dtype=np.float32))

@@ -152,6 +152,19 @@ class Topology:
         self.perf = perf.resolve(opt.perf_params)
         if self.perf.enabled:
             perf.export_env(self.perf)
+        # replica plane (ISSUE 15): resolved once + exported on the same
+        # spawn-inheritance contract.  The ReplicaRegistry itself rides
+        # the fleet DCN gateway (fleet.FleetTopology builds it); a plain
+        # Topology with replicas > 1 has no registry and the learner
+        # downgrades loudly to solo (agents/learner.py delegation gate).
+        from pytorch_distributed_tpu.parallel.dcn import (
+            export_replica_env, resolve_replica,
+        )
+
+        self.replica = resolve_replica(opt.replica_params)
+        if self.replica.replicas > 1:
+            export_replica_env(self.replica)
+        self.replica_registry = None
         # ---- mission control (ISSUE 10): fleet metrics aggregation +
         # SLO/alert engine + opt-in OpenMetrics endpoint.  Built here
         # (unstarted) so the fleet gateway's T_METRICS sink has a

@@ -46,6 +46,7 @@ import hashlib
 import json
 import os
 import shutil
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -695,6 +696,24 @@ def resolve_epoch(model_name: str,
             print(f"[checkpoint] skipping corrupt epoch {path}: "
                   + "; ".join(bad))
     return None
+
+
+def await_epoch(model_name: str, min_step: int, timeout: float = 30.0,
+                poll: float = 0.1) -> Optional[EpochInfo]:
+    """Poll ``resolve_epoch`` until a digest-valid epoch with
+    ``learner_step >= min_step`` appears (or the timeout lapses).  The
+    ISSUE-15 rejoin leg: a replica learner re-entering the fleet loads
+    the barrier epoch the lead replica commits for it — the commit and
+    the load race only through the filesystem, and the atomic manifest
+    rename means this poll can never observe a torn epoch."""
+    deadline = time.monotonic() + timeout
+    while True:
+        info = resolve_epoch(model_name)
+        if info is not None and info.learner_step >= min_step:
+            return info
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(poll)
 
 
 def load_epoch_state(info: EpochInfo, template: Any) -> Any:

@@ -20,6 +20,12 @@ ROLE_SALTS = {
     "tester": 4_000_000,
     "logger": 5_000_000,
     "env": 6_000_000,
+    # the ISSUE-15 multi-learner plane: ONE shared stream per fleet
+    # (index 0 by convention — rank folding differentiates replicas),
+    # plus the deterministic shared ingest stream (indexed by a counter
+    # every replica advances identically)
+    "replica-plane": 7_000_000,
+    "replica-ingest": 8_000_000,
 }
 
 

@@ -437,6 +437,18 @@ DEFAULT_RULES = (
     # checkpoint-heavy window never pages; non-anakin runs never
     # report the tag, so the rule stays silently inert there
     "rollout_starvation: anakin/duty_cycle < 0.02 for 120s",
+    # replica plane (ISSUE 15): membership-size ABSENCE — the registry
+    # emits ``replica/members`` on every lease event and renew, so the
+    # tag going silent means the whole replica plane (or the lead
+    # gateway's registry) stopped, which no threshold on a dead series
+    # could catch; non-replicated runs never report the tag, so the
+    # rule stays silently inert there (absence-never-seen-never-fires)
+    "replica_membership: replica/members absent 120s",
+    # generation churn: lease-consuming events (expiries + double-lease
+    # fences) per rolling minute.  Sustained churn means replicas are
+    # crash-looping through lease/rejoin cycles — each individual cycle
+    # "recovers", so only the rate exposes the loop
+    "replica_churn: replica/generation_churn > 3 for 120s",
 )
 
 
@@ -886,6 +898,7 @@ class MissionControl:
                 "actor/env_frames_per_s", "data/staleness_p50",
                 "replay/priority_ess_frac", "flow/overload_state",
                 "anakin/duty_cycle", "anakin/replay_fill",
+                "replica/members", "replica/generation_churn",
                 "learner/critic_loss", "evaluator/avg_reward",
                 "actor/avg_reward", "learner/steps_per_sec")
 

@@ -1,0 +1,44 @@
+"""Spawn child for the slow replica-topology drill
+(tests/test_replicas.py): one REAL replica learner process — jax
+grad/apply split, lease client, checkpoint-epoch rejoin — dialling the
+parent's gateway over loopback.  ``REPLICA_FAULTS=kill@N`` SIGKILLs it
+at round N through the production fault plane (utils/faults.py); a
+SIGTERM from the parent is the preemption notice (drain + exit 0)."""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--replica-id", type=int, required=True)
+    ap.add_argument("--root-dir", required=True)
+    ap.add_argument("--refs", default="replicadrill")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--steps", type=int, default=500000)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_distributed_tpu.config import build_options
+    from pytorch_distributed_tpu.fleet import run_replica_host
+
+    opt = build_options(
+        1, root_dir=args.root_dir, refs=args.refs, seed=args.seed,
+        hidden_dim=32, batch_size=8, memory_size=128, learn_start=32,
+        steps=args.steps, replicas=2, lease_s=1.5,
+        join_timeout_s=120.0, evaluator_nepisodes=0,
+    )
+    run_replica_host(opt, args.coordinator, args.replica_id)
+
+
+if __name__ == "__main__":
+    main()

@@ -167,8 +167,19 @@ class TestHostSidecars:
         for i in range(4):
             f.feed(_mk_transition(i, make_prov(0, i, 1, i)), 0.5)
         f.flush()
-        while owner.drain():
-            pass
+        # mp.Queue hands chunks to its feeder thread asynchronously: a
+        # single drain-until-empty pass can land BETWEEN two chunks'
+        # visibility and under-read the queue (observed on this image:
+        # rows [2, 3] still in flight -> -1 provenance sentinels), so
+        # poll until every row has arrived
+        drained = 0
+        deadline = time.monotonic() + 10.0
+        while drained < 4:
+            drained += owner.drain()
+            if drained < 4:
+                assert time.monotonic() < deadline, \
+                    f"only {drained}/4 rows drained"
+                time.sleep(0.01)
         np.testing.assert_array_equal(
             owner.provenance_of(np.arange(4))[:, 3], np.arange(4))
         assert owner.priority_leaves() is not None

@@ -1,0 +1,691 @@
+"""Elastic multi-learner replica plane (ISSUE 15).
+
+Three test tiers:
+
+- **Registry/lease units** (numpy, milliseconds): monotonic
+  generations, expiry, double-lease fencing, round-stall expulsion of a
+  hung-but-renewing member, stale-generation gradient/priority rejects,
+  the join barrier, and the decorrelated redial-jitter satellite.
+- **Wire drills**: the same machinery through a real ``DcnGateway``
+  over loopback — lease verbs, a two-client reduced round, fenced
+  zombies, the no-registry error leg, and the fleet_top replicas panel.
+- **The degraded-parity oracle** (jax, tier-1 acceptance): a 2-replica
+  CPU run that loses one replica at round K must produce params
+  bit-identical — every leaf, plus the PER priorities and the
+  key-stream schedule — to the solo learner from the degradation round
+  onward under a fixed seed; and the dead replica's stale-generation
+  write-back is a counted reject that touches nothing.
+- **Slow**: the real-topology kill→degrade→rejoin acceptance drill —
+  two spawned replica learner processes, one SIGKILLed mid-run through
+  the production ``REPLICA_FAULTS`` plane, a replacement rejoining at a
+  new generation through the checkpoint-epoch barrier.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+from pytorch_distributed_tpu.config import ReplicaParams, build_options
+from pytorch_distributed_tpu.parallel.dcn import (
+    RSTAT_FENCED, RSTAT_NOREG, RSTAT_OK, RSTAT_STALE, DcnGateway,
+    LocalReplicaChannel, ReplicaClient, ReplicaFenced, ReplicaRegistry,
+    redial_backoff, resolve_replica,
+)
+
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _registry(replicas=2, lease_s=0.4, **kw) -> ReplicaRegistry:
+    return ReplicaRegistry(ReplicaParams(replicas=replicas,
+                                         lease_s=lease_s, **kw))
+
+
+def _gateway(registry=None):
+    store = ParamStore(4)
+    store.publish(np.zeros(4, dtype=np.float32))
+    return DcnGateway(store, GlobalClock(), ActorStats(),
+                      put_chunk=lambda items: None, host="127.0.0.1",
+                      port=0, replicas=registry)
+
+
+# ---------------------------------------------------------------------------
+# lease-fenced membership units
+# ---------------------------------------------------------------------------
+
+class TestLeaseMembership:
+    def test_acquire_grants_monotonic_generations(self):
+        reg = _registry()
+        g1 = reg.acquire(0, incarnation=10)["generation"]
+        g2 = reg.acquire(1, incarnation=10)["generation"]
+        assert g2 > g1
+        assert sorted(reg.status_block()["members"]) == ["0", "1"]
+
+    def test_missed_lease_expires_and_fences(self):
+        reg = _registry(lease_s=0.15)
+        reg.acquire(0, incarnation=1)
+        time.sleep(0.3)
+        reg.renew(0, -1)  # any registry op runs the expiry pass
+        assert reg.leases_expired == 1
+        assert reg.status_block()["members"] == {}
+        assert reg.status_block()["degraded"]
+
+    def test_renew_extends_and_expired_renew_says_so(self):
+        reg = _registry(lease_s=0.3)
+        g = reg.acquire(0, incarnation=1)["generation"]
+        for _ in range(4):
+            time.sleep(0.15)
+            assert reg.renew(0, g)["status"] == "ok"
+        time.sleep(0.6)
+        assert reg.renew(0, g)["status"] == "expired"
+        assert reg.leases_expired == 1
+
+    def test_double_lease_newer_incarnation_wins(self):
+        """Same slot, two incarnations: the newer incarnation evicts
+        (counted fence), the older/equal one is refused — PR 1's slot
+        fencing lifted to the learner plane."""
+        reg = _registry()
+        g_old = reg.acquire(0, incarnation=5)["generation"]
+        assert reg.acquire(0, incarnation=5)["status"] == "refused"
+        assert reg.acquire(0, incarnation=4)["status"] == "refused"
+        r = reg.acquire(0, incarnation=6)
+        assert r["status"] == "ok" and r["generation"] > g_old
+        assert reg.lease_fenced == 1
+        # the fenced generation can no longer write anything
+        res = reg.submit(0, g_old, 0, np.zeros(2, np.float32))
+        assert res["status"] in (RSTAT_FENCED, RSTAT_STALE)
+        assert reg.stale_grad_rejected == 1
+
+    def test_release_shrinks_membership_immediately(self):
+        reg = _registry()
+        g = reg.acquire(0, incarnation=1)["generation"]
+        reg.acquire(1, incarnation=1)
+        reg.release(0, g)
+        assert reg.leases_released == 1
+        assert sorted(reg.status_block()["members"]) == ["1"]
+
+
+class TestRoundExchange:
+    def _pair(self, reg):
+        a = LocalReplicaChannel(reg, 0)
+        b = LocalReplicaChannel(reg, 1)
+        a.acquire()
+        b.acquire()
+        return a, b
+
+    def test_round_reduces_mean_in_replica_order(self):
+        reg = _registry(lease_s=5.0)
+        a, b = self._pair(reg)
+        out = [None, None]
+
+        def run(ch, i, v):
+            out[i] = ch.submit_round(
+                0, np.asarray([v, v], np.float32),
+                pidx=np.asarray([i], np.int32),
+                ptd=np.asarray([0.5 + i], np.float32))
+
+        t = threading.Thread(target=run, args=(b, 1, 3.0), daemon=True)
+        t.start()
+        run(a, 0, 1.0)
+        t.join(5)
+        assert out[0]["status"] == RSTAT_OK
+        assert np.array_equal(out[0]["grad"],
+                              np.asarray([2.0, 2.0], np.float32))
+        assert np.array_equal(out[0]["grad"], out[1]["grad"])
+        assert out[0]["members"] == [0, 1]
+        # merged write-backs: one group per contributor, ascending id,
+        # identical on both replies
+        assert [w[0] for w in out[0]["writebacks"]] == [0, 1]
+        assert [(w[0], list(w[1])) for w in out[0]["writebacks"]] == \
+            [(w[0], list(w[1])) for w in out[1]["writebacks"]]
+
+    def test_expiry_mid_round_completes_over_survivors(self):
+        """B contributes to round 0 then dies (no renew): A's round 1
+        must complete over {A} within one lease window, and the reduce
+        is A's own gradient bit-for-bit (mean over one contributor)."""
+        reg = _registry(lease_s=0.3)
+        a, b = self._pair(reg)
+        out = [None, None]
+
+        def run0(ch, i):
+            out[i] = ch.submit_round(0, np.ones(2, np.float32) * (i + 1))
+
+        t = threading.Thread(target=run0, args=(b, 1), daemon=True)
+        t.start()
+        run0(a, 0)
+        t.join(5)
+        assert out[0]["status"] == RSTAT_OK
+        # B goes silent (its renewer never ran); A's next round fences it
+        g = np.asarray([7.5, -2.25], np.float32)
+        t0 = time.monotonic()
+        res = a.submit_round(1, g)
+        took = time.monotonic() - t0
+        assert res["status"] == RSTAT_OK
+        assert res["members"] == [0]
+        assert np.array_equal(res["grad"], g)  # mean over {A} == A's grad
+        assert took < 3 * 0.3 + 1.0  # within the lease-window contract
+        assert reg.leases_expired == 1
+        assert reg.degraded_completions == 1
+
+    def test_hung_but_renewing_member_is_round_stalled(self):
+        """The hang mode: a member whose renewer faithfully renews but
+        whose round loop is frozen.  Leases prove liveness, rounds
+        prove progress — the registry's round-stall rule must expel it
+        within one lease window and count the expiry."""
+        reg = _registry(lease_s=0.3)
+        a, b = self._pair(reg)
+        b.start_renewer(period=0.05)  # B renews forever, submits never
+        res = a.submit_round(0, np.ones(2, np.float32))
+        assert res["status"] == RSTAT_OK
+        assert res["members"] == [0]
+        assert reg.leases_expired == 1
+        b.close()
+        # the expelled member's next submit is fenced, counted
+        out = reg.submit(1, b.generation, 1, np.zeros(2, np.float32))
+        assert out["status"] in (RSTAT_FENCED, RSTAT_STALE)
+        assert reg.stale_grad_rejected == 1
+
+    def test_stale_generation_prio_writeback_rejected(self):
+        reg = _registry(lease_s=0.15)
+        a, b = self._pair(reg)
+        a.start_renewer(period=0.04)  # A stays live through the sleep
+        dead_gen = b.generation
+        time.sleep(0.35)  # B never renews: lease expires
+        a.renew()
+        assert reg.leases_expired >= 1
+        res = reg.merge_prio(1, dead_gen, np.asarray([3], np.int32),
+                             np.asarray([9.9], np.float32))
+        assert res["status"] == "stale"
+        assert reg.stale_prio_rejected == 1
+        # a LIVE generation's out-of-round write-back queues for the
+        # next round's merged reply instead
+        ok = reg.merge_prio(0, a.generation,
+                            np.asarray([1], np.int32),
+                            np.asarray([0.5], np.float32))
+        assert ok["status"] == "ok" and reg.prio_merged_rows == 1
+        out = a.submit_round(0, np.zeros(2, np.float32))
+        assert (0, [1]) in [(w[0], list(w[1])) for w in
+                            out["writebacks"]]
+        a.close()
+
+    def test_rejoin_after_sigkill_new_generation_via_barrier(self):
+        """Kill = the channel vanishes without release; the replacement
+        acquires at a NEW generation, the survivors' barrier round
+        carries ``epoch_due``, and after activation the membership (and
+        round numbering) is whole again."""
+        reg = _registry(lease_s=0.25, join_timeout_s=10.0)
+        a, b = self._pair(reg)
+        out = [None, None]
+
+        def run0(ch, i):
+            out[i] = ch.submit_round(0, np.ones(2, np.float32))
+
+        t = threading.Thread(target=run0, args=(b, 1), daemon=True)
+        t.start()
+        run0(a, 0)
+        t.join(5)
+        dead_gen = b.generation  # B is SIGKILLed here: no release
+        # A trains on alone; B's lease expires, rounds go degraded
+        assert a.submit_round(1, np.ones(2, np.float32))["members"] \
+            == [0]
+
+        # the replacement: new channel, same slot, NEW generation
+        b2 = LocalReplicaChannel(reg, 1)
+        reply = b2.acquire()
+        assert reply["generation"] > dead_gen
+        barrier = reply["epoch_barrier"]
+        assert barrier is not None and reply["round"] == barrier + 1
+        b2.start_renewer(period=0.05)
+
+        committed = {}
+
+        def survivor():
+            r = 2
+            while r <= barrier + 1:
+                res = a.submit_round(r, np.full(2, float(r),
+                                                np.float32))
+                assert res["status"] == RSTAT_OK
+                if res["epoch_due"]:
+                    committed["step"] = r + 1
+                    a.note_epoch(r, r + 1)
+                r += 1
+            committed["final_members"] = res["members"]
+
+        ts = threading.Thread(target=survivor, daemon=True)
+        ts.start()
+        # the joiner: poll for the barrier epoch, "load" it, activate,
+        # then contribute its entry round
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            j = b2.poll_join()
+            if j and j.get("epoch_step") is not None:
+                break
+            time.sleep(0.02)
+        assert j and j["epoch_step"] == committed["step"]
+        b2.activate(j["epoch_step"])
+        res = b2.submit_round(barrier + 1,
+                              np.full(2, float(barrier + 1),
+                                      np.float32))
+        ts.join(10)
+        assert res["status"] == RSTAT_OK
+        assert res["members"] == [0, 1]
+        assert committed["final_members"] == [0, 1]
+        assert reg.joins_completed == 1
+        assert reg.leases_expired == 1
+        # the zombie's stale generation still bounces
+        z = reg.submit(1, dead_gen, barrier + 1,
+                       np.zeros(2, np.float32))
+        assert z["status"] in (RSTAT_FENCED, RSTAT_STALE)
+
+
+# ---------------------------------------------------------------------------
+# the reconnect thundering-herd satellite
+# ---------------------------------------------------------------------------
+
+class TestRedialJitter:
+    def _seq(self, slot, n=8):
+        rng = np.random.default_rng((0xDC2, slot))
+        d, seq = 0.05, []
+        for _ in range(n):
+            d = redial_backoff(rng, d)
+            seq.append(d)
+        return seq
+
+    def test_slots_spread_their_redial_times(self):
+        assert self._seq(0) != self._seq(1)
+        assert self._seq(3) != self._seq(4)
+
+    def test_deterministic_per_slot_and_bounded(self):
+        """Seeded drills stay reproducible: the schedule is a pure
+        function of the slot, and every delay respects [base, cap]."""
+        assert self._seq(2) == self._seq(2)
+        for d in self._seq(5, n=32):
+            assert 0.05 <= d <= 1.0
+
+    def test_dcn_client_carries_a_slot_seeded_stream(self):
+        gw = _gateway()
+        try:
+            from pytorch_distributed_tpu.parallel.dcn import DcnClient
+
+            c0 = DcnClient(("127.0.0.1", gw.port), process_ind=0)
+            c1 = DcnClient(("127.0.0.1", gw.port), process_ind=1)
+            try:
+                d0 = [redial_backoff(c0._redial_rng, 0.05)
+                      for _ in range(4)]
+                d1 = [redial_backoff(c1._redial_rng, 0.05)
+                      for _ in range(4)]
+                assert d0 != d1
+            finally:
+                c0.close()
+                c1.close()
+        finally:
+            gw.close()
+
+
+# ---------------------------------------------------------------------------
+# wire drills + the fleet_top replicas panel
+# ---------------------------------------------------------------------------
+
+class TestReplicaWire:
+    def test_lease_and_round_over_the_wire(self):
+        reg = _registry(lease_s=1.0)
+        gw = _gateway(reg)
+        try:
+            a = ReplicaClient(("127.0.0.1", gw.port), 0)
+            b = ReplicaClient(("127.0.0.1", gw.port), 1)
+            a.acquire()
+            b.acquire()
+            assert a.generation != b.generation
+            out = [None, None]
+
+            def run(ch, i, v):
+                out[i] = ch.submit_round(
+                    0, np.asarray([v], np.float32),
+                    pidx=np.asarray([i], np.int32),
+                    ptd=np.asarray([1.0], np.float32))
+
+            t = threading.Thread(target=run, args=(b, 1, 4.0),
+                                 daemon=True)
+            t.start()
+            run(a, 0, 2.0)
+            t.join(5)
+            for o in out:
+                assert o["status"] == RSTAT_OK
+                assert np.array_equal(o["grad"],
+                                      np.asarray([3.0], np.float32))
+                assert o["members"] == [0, 1]
+                assert len(o["writebacks"]) == 2
+            a.release()
+            b.release()
+            a.close()
+            b.close()
+        finally:
+            gw.close()
+
+    def test_stale_generation_fenced_over_the_wire(self):
+        reg = _registry(lease_s=0.2)
+        gw = _gateway(reg)
+        try:
+            a = ReplicaClient(("127.0.0.1", gw.port), 0)
+            a.acquire()
+            dead = a.generation
+            time.sleep(0.5)  # expire (no renewer started)
+            res = a.submit_round(0, np.zeros(2, np.float32))
+            assert res["status"] in (RSTAT_FENCED, RSTAT_STALE)
+            assert a.fenced.is_set()
+            z = ReplicaClient(("127.0.0.1", gw.port), 0)
+            z.generation = dead
+            assert z.merge_prio(np.asarray([0], np.int32),
+                                np.asarray([1.0], np.float32)
+                                )["status"] == "stale"
+            z.close()
+            a.close()
+            assert reg.stale_grad_rejected == 1
+            assert reg.stale_prio_rejected == 1
+        finally:
+            gw.close()
+
+    def test_registryless_gateway_answers_errors_not_crashes(self):
+        gw = _gateway(None)
+        try:
+            c = ReplicaClient(("127.0.0.1", gw.port), 0)
+            with pytest.raises(ReplicaFenced):
+                c.acquire()
+            c.generation = 1
+            res = c.submit_round(0, np.zeros(2, np.float32))
+            assert res["status"] == RSTAT_NOREG
+            c.close()
+        finally:
+            gw.close()
+
+    def test_fleet_top_replicas_panel_and_json(self):
+        """The satellite: the STATUS ``replicas`` block round-trips the
+        wire, renders as a panel line, stays JSON-serializable, and
+        shouts DEGRADED when membership is short."""
+        import tools.fleet_top as ft
+        from pytorch_distributed_tpu.parallel.dcn import fetch_status
+
+        reg = _registry(replicas=2, lease_s=5.0)
+        gw = _gateway(reg)
+        try:
+            a = LocalReplicaChannel(reg, 0)
+            a.acquire()
+            status = fetch_status(("127.0.0.1", gw.port))
+            assert "replicas" in status
+            json.dumps(status)  # the --json path must stay serializable
+            line = ft.replicas_line(status)
+            assert line is not None and "replicas:" in line
+            assert "1/2" in line and "DEGRADED" in line
+            assert "r0" in line and "gen" in line
+            b = LocalReplicaChannel(reg, 1)
+            b.acquire()
+            status = fetch_status(("127.0.0.1", gw.port))
+            line = ft.replicas_line(status)
+            assert "2/2" in line and "DEGRADED" not in line
+            # the whole panel renders with the replicas row in place
+            assert "replicas:" in ft.render(status)
+        finally:
+            gw.close()
+
+    def test_resolve_replica_env_contract(self, monkeypatch):
+        monkeypatch.setenv("TPU_APEX_REPLICA_REPLICAS", "3")
+        monkeypatch.setenv("TPU_APEX_REPLICA_LEASE_S", "2.5")
+        rp = resolve_replica()
+        assert rp.replicas == 3
+        assert rp.lease_s == 2.5
+        base = ReplicaParams(lease_s=9.0)
+        assert resolve_replica(base).lease_s == 2.5  # env wins
+        assert base.lease_s == 9.0  # input never mutated
+
+
+# ---------------------------------------------------------------------------
+# chaos drills through the production fault plane (tier-1 smoke)
+# ---------------------------------------------------------------------------
+
+class TestReplicaChaosDrills:
+    @pytest.mark.timeout(120)
+    def test_kill_then_rejoin_drill_exits_clean(self):
+        """The acceptance drill: membership shrinks on the kill, the
+        membership alert fires, the replacement rejoins through the
+        epoch barrier, the alert resolves, and every fencing/ledger
+        counter is exact — zero violations."""
+        sys.path.insert(0, os.path.join(_TESTS_DIR, os.pardir))
+        from tools.chaos_soak import replica_soak
+
+        report = replica_soak(replicas=2, rounds=45, seed=3, kill_at=8,
+                              rejoin=True, verbose=False)
+        assert report["violations"] == []
+        assert report["counters"]["stale_grad_rejected"] == 1
+        assert report["counters"]["stale_prio_rejected"] == 1
+        assert report["alerts"]["fired"] == ["replica_degraded"]
+        assert report["alerts"]["unresolved"] == []
+
+    @pytest.mark.slow
+    @pytest.mark.timeout(300)
+    def test_hang_replica_drill_exits_clean(self):
+        sys.path.insert(0, os.path.join(_TESTS_DIR, os.pardir))
+        from tools.chaos_soak import replica_soak
+
+        report = replica_soak(replicas=2, rounds=60, seed=5,
+                              hang_at=10, rejoin=True, verbose=False)
+        assert report["violations"] == []
+
+
+# ---------------------------------------------------------------------------
+# the degraded-parity oracle (jax; tier-1 acceptance)
+# ---------------------------------------------------------------------------
+
+def _oracle_opt(tmp_path, refs="replicas-oracle"):
+    return build_options(
+        1, root_dir=str(tmp_path), refs=refs, seed=11,
+        hidden_dim=32, batch_size=8, memory_size=128, learn_start=32,
+        steps=10_000, replicas=2, lease_s=0.6,
+        evaluator_nepisodes=0)
+
+
+class TestDegradedParityOracle:
+    @pytest.mark.timeout(600)
+    def test_survivor_bit_identical_to_solo_from_degradation(
+            self, tmp_path):
+        """THE acceptance oracle: 2 replicas train through the real
+        registry; replica 1 is killed at round K (stops submitting AND
+        renewing — the in-process image of SIGKILL).  The survivor's
+        trajectory from round K onward must be bit-identical — every
+        param leaf, the full PER ring priorities, and the key-stream
+        schedule — to a solo driver seeded with the survivor's state at
+        the degradation boundary.  Plus: the zombie's stale-generation
+        priority write-back after the kill is rejected-and-counted and
+        perturbs nothing (the survivor's priorities still match the
+        solo leg that never saw it)."""
+        import jax
+
+        from pytorch_distributed_tpu.agents.learner import (
+            ReplicaLearnerDriver,
+        )
+        from pytorch_distributed_tpu.factory import probe_env
+
+        opt = _oracle_opt(tmp_path)
+        spec = probe_env(opt)
+        reg = ReplicaRegistry(resolve_replica(opt.replica_params))
+        chA = LocalReplicaChannel(reg, 0)
+        chB = LocalReplicaChannel(reg, 1)
+        dA = ReplicaLearnerDriver(opt, spec, 0, chA)
+        dB = ReplicaLearnerDriver(opt, spec, 1, chB)
+        chA.acquire()
+        chB.acquire()
+        chA.start_renewer(period=0.1)
+        chB.start_renewer(period=0.1)
+        dA.members = [0, 1]
+        dB.members = [0, 1]
+        dA.prefill(64)
+        dB.prefill(64)
+
+        K, T = 4, 9
+        traj = {}
+
+        def cap_a(r, drv):
+            traj[r] = drv.snapshot()
+
+        def run_b():
+            dB.run_rounds(K)   # rounds 0..K-1, then "SIGKILL"
+            chB.close()        # the renewer dies with the process
+
+        tb = threading.Thread(target=run_b, daemon=True)
+        tb.start()
+        dA.run_rounds(T, capture=cap_a)
+        tb.join(30)
+        assert not tb.is_alive()
+        chA.close()
+        assert reg.leases_expired == 1
+        assert reg.degraded_completions >= 1
+        assert dA.members == [0]
+
+        # two-replica rounds really were two-replica (the merge carried
+        # both write-back groups), degraded rounds carried one
+        assert len(traj) == T
+
+        # ---- zombie leg: the dead generation is fenced, counted, and
+        # side-effect-free
+        dead_gen = chB.generation
+        z = reg.merge_prio(1, dead_gen, np.asarray([0], np.int32),
+                           np.asarray([99.0], np.float32))
+        assert z["status"] == "stale"
+        assert reg.stale_prio_rejected == 1
+        zg = reg.submit(1, dead_gen, T - 1, np.zeros(2, np.float32))
+        assert zg["status"] in (RSTAT_FENCED, RSTAT_STALE)
+
+        # ---- solo leg: same construction, N=1 registry, seeded with
+        # the survivor's state at the degradation boundary
+        reg2 = ReplicaRegistry(ReplicaParams(replicas=1, lease_s=5.0))
+        chS = LocalReplicaChannel(reg2, 0)
+        dS = ReplicaLearnerDriver(opt, spec, 0, chS)
+        chS.acquire()
+        chS.start_renewer(period=0.5)
+        dS.load_snapshot(traj[K - 1])
+        dS.members = [0]
+        solo = {}
+        dS.run_rounds(T, capture=lambda r, drv:
+                      solo.__setitem__(r, drv.snapshot()))
+        chS.close()
+
+        for r in range(K, T):
+            a_leaves = jax.tree_util.tree_leaves(traj[r]["state"])
+            s_leaves = jax.tree_util.tree_leaves(solo[r]["state"])
+            assert len(a_leaves) == len(s_leaves)
+            for x, y in zip(a_leaves, s_leaves):
+                assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                    f"param leaf diverged at round {r}"
+            ra, rs = traj[r]["ring"], solo[r]["ring"]
+            assert np.array_equal(np.asarray(ra.priority),
+                                  np.asarray(rs.priority)), \
+                f"PER priorities diverged at round {r}"
+            assert np.array_equal(np.asarray(ra.max_priority),
+                                  np.asarray(rs.max_priority))
+        # the key-stream schedule: the survivor at rank 0 of {0} drew
+        # the EXACT keys the solo driver drew
+        ka, ks = dict(dA.key_log), dict(dS.key_log)
+        for r in range(K, T):
+            assert np.array_equal(ka[r], ks[r]), \
+                f"key stream diverged at round {r}"
+
+
+# ---------------------------------------------------------------------------
+# slow: the real-topology kill -> degrade -> rejoin acceptance drill
+# ---------------------------------------------------------------------------
+
+class TestRealTopologyReplicaDrill:
+    @pytest.mark.slow
+    @pytest.mark.timeout(840)
+    def test_sigkill_degrade_rejoin_on_real_processes(self, tmp_path):
+        """Two REAL replica learner processes against a real gateway:
+        replica 1 SIGKILLs itself at round 25 through the production
+        ``REPLICA_FAULTS`` plane, the survivor degrades (counted), a
+        replacement process rejoins at a new generation through the
+        checkpoint-epoch barrier, and a SIGTERM preemption drains both
+        to clean exits with a committed final epoch."""
+        from pytorch_distributed_tpu.utils import checkpoint as ckpt
+
+        reg = ReplicaRegistry(ReplicaParams(
+            replicas=2, lease_s=1.5, join_timeout_s=120.0))
+        gw = _gateway(reg)
+        child_py = os.path.join(_TESTS_DIR, "_replica_child.py")
+        refs = "replicadrill"
+
+        def spawn(rid, faults=""):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("REPLICA_FAULTS", None)
+            if faults:
+                env["REPLICA_FAULTS"] = faults
+            return subprocess.Popen(
+                [sys.executable, child_py,
+                 "--coordinator", f"127.0.0.1:{gw.port}",
+                 "--replica-id", str(rid),
+                 "--root-dir", str(tmp_path), "--refs", refs],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT)
+
+        def wait_for(pred, timeout, what):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if pred():
+                    return
+                time.sleep(0.2)
+            raise AssertionError(f"timed out waiting for {what}")
+
+        procs = []
+        try:
+            p0 = spawn(0)
+            p1 = spawn(1, faults="kill@25")
+            procs = [p0, p1]
+            wait_for(lambda: len(reg.status_block()["members"]) == 2,
+                     300, "both replicas to lease")
+            wait_for(lambda: reg.rounds_completed > 0, 300,
+                     "the first completed round")
+            # the production fault plane SIGKILLs replica 1 at round 25
+            wait_for(lambda: p1.poll() is not None, 300,
+                     "the kill@25 SIGKILL")
+            assert p1.returncode == -signal.SIGKILL
+            wait_for(lambda: reg.leases_expired >= 1, 60,
+                     "the dead lease to expire")
+            wait_for(lambda: reg.degraded_completions >= 1, 60,
+                     "a degraded round completion")
+            # the replacement: same slot, new generation, epoch barrier
+            p1b = spawn(1)
+            procs.append(p1b)
+            wait_for(lambda: reg.joins_completed == 1, 420,
+                     "the rejoin to activate through the epoch barrier")
+            wait_for(
+                lambda: len(reg.status_block()["members"]) == 2, 60,
+                "membership to recover")
+            r_mark = reg.rounds_completed
+            wait_for(lambda: reg.rounds_completed > r_mark + 3, 120,
+                     "post-rejoin rounds at N=2")
+            # preemption: both drain, commit, release, exit 0
+            p0.send_signal(signal.SIGTERM)
+            p1b.send_signal(signal.SIGTERM)
+            for p in (p0, p1b):
+                p.wait(timeout=180)
+                assert p.returncode == 0, \
+                    p.stdout.read().decode(errors="replace")[-2000:]
+            assert reg.leases_released == 2
+            info = ckpt.resolve_epoch(
+                os.path.join(str(tmp_path), "models", refs))
+            assert info is not None and info.learner_step > 0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(10)
+                if p.stdout:
+                    p.stdout.close()
+            gw.close()

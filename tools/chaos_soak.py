@@ -60,6 +60,21 @@ set:
 - **fairness breached** (``--slow-slot``) — a well-paced actor starved
   (acked below 70% of minted) by its runaway neighbour.
 
+Replica drills (ISSUE 15, the elastic multi-learner plane —
+parallel/dcn.py ReplicaRegistry): ``--kill-replica AT`` (the highest
+replica crashes at round AT through the production REPLICA fault plane
+— dies WITHOUT releasing, so its lease must expire and fence),
+``--hang-replica AT`` (the round loop freezes while the lease renewer
+keeps renewing — only the registry's round-stall rule can fence it),
+and ``--rejoin`` (a replacement re-leases at a NEW generation through
+the join-barrier epoch).  Verdict failures: deadlock,
+divergent-params across live replicas, unfenced-stale-write (a
+zombie's stale-generation gradient or priority write-back accepted),
+expected-alert-never-fired / any-unexpected-alert /
+unresolved-after-rejoin on the ``replica_degraded`` membership rule,
+and any lease/round/fence counter off its script-predicted value
+(EXACT-ledger verdict).  See ``replica_soak``.
+
 Usage:
     python tools/chaos_soak.py --seconds 30 --actors 4 --seed 0
     python tools/chaos_soak.py --seconds 60 --restart-every 5
@@ -68,6 +83,8 @@ Usage:
     python tools/chaos_soak.py --seconds 12 --flood
     python tools/chaos_soak.py --seconds 12 --slow-learner-ingest 3
     python tools/chaos_soak.py --seconds 12 --slow-slot
+    python tools/chaos_soak.py --kill-replica 8 --rejoin
+    python tools/chaos_soak.py --hang-replica 10 --rejoin
 
 The same ``SyntheticActor`` drives the deterministic chaos scenarios in
 tests/test_chaos.py; this entry point is the long-haul randomized
@@ -769,6 +786,431 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
     return report
 
 
+# ---------------------------------------------------------------------------
+# replica-plane drills (ISSUE 15): kill / hang / rejoin through the
+# production fault plane
+# ---------------------------------------------------------------------------
+
+class SyntheticReplica:
+    """Numpy-only learner replica for the chaos drills: the REAL
+    lease/round/fencing machinery — ReplicaClient over the wire against
+    a gateway's ReplicaRegistry — with a toy params vector standing in
+    for the TrainState, so membership drills run in milliseconds
+    without jax (the jax-true oracle lives in tests/test_replicas.py).
+
+    Faults ride the production plane (utils/faults.py), consulted once
+    per round exactly like the real driver: ``crash@N`` dies without
+    releasing the lease (the in-process stand-in for SIGKILL — the
+    renewer stops with the 'process', so the lease expires and fences);
+    ``hang@N:S`` freezes the round loop while the renewer keeps
+    faithfully renewing — the alive-but-stuck mode only the registry's
+    round-stall rule can fence.
+
+    ``history[r]`` records the params vector after round ``r`` — the
+    drill's divergent-params verdict compares these across replicas."""
+
+    def __init__(self, address, rid: int, replicas: int, dim: int = 64,
+                 rounds: int = 30, pace: float = 0.02,
+                 faults: Optional[FaultInjector] = None,
+                 epoch_store: Optional[dict] = None,
+                 join: bool = False, seed: int = 0,
+                 hold: Optional[threading.Event] = None):
+        self.address = address
+        self.rid = rid
+        self.replicas = replicas
+        self.dim = dim
+        self.rounds = rounds
+        self.pace = pace
+        self.faults = faults or FaultInjector(name=f"replica-{rid}")
+        self.epoch_store = epoch_store if epoch_store is not None else {}
+        self.join = join
+        self.rng = np.random.default_rng((seed, rid))
+        self.params = np.zeros(dim, np.float32)
+        self.history: Dict[int, np.ndarray] = {}
+        self.members_seen: List[List[int]] = []
+        self.outcome: Optional[str] = None
+        self.dead_generation: Optional[int] = None
+        self.client = None
+        self.thread: Optional[threading.Thread] = None
+        # drill choreography: a finished replica HOLDS its lease (the
+        # renewer keeps it) until the orchestrator has read the alert
+        # verdict from a fully-recovered membership, then releases
+        self.hold = hold
+        self.done_rounds = threading.Event()
+
+    def start(self) -> "SyntheticReplica":
+        self.thread = threading.Thread(
+            target=self.run, name=f"chaos-replica-{self.rid}",
+            daemon=True)
+        self.thread.start()
+        return self
+
+    def run(self) -> None:
+        from pytorch_distributed_tpu.parallel.dcn import (
+            RSTAT_OK, ReplicaClient, ReplicaFenced,
+        )
+        from pytorch_distributed_tpu.utils.faults import InjectedCrash
+
+        try:
+            self.client = client = ReplicaClient(self.address, self.rid)
+            reply = client.acquire()
+        except (ReplicaFenced, ConnectionError, OSError) as e:
+            self.outcome = f"lease-refused: {e!r}"
+            return
+        client.start_renewer()
+        r = int(reply.get("round", 0))
+        barrier = reply.get("epoch_barrier")
+        if barrier is None:
+            # fresh start: hold the first submit until the whole fleet
+            # has leased — a peer acquiring after round 0 opens would
+            # otherwise (correctly, but nondeterministically for the
+            # drill ledger) enter through the join barrier instead
+            client.wait_members(self.replicas, timeout=10.0)
+        if barrier is not None:
+            # the joiner leg: wait for the survivors' barrier epoch,
+            # load exactly it, fast-forward, activate
+            deadline = time.monotonic() + 20.0
+            epoch_step = None
+            while time.monotonic() < deadline:
+                j = client.poll_join()
+                if j is None:
+                    self.outcome = "join-cancelled"
+                    client.close()
+                    return
+                if j.get("epoch_step") is not None:
+                    epoch_step = int(j["epoch_step"])
+                    break
+                time.sleep(0.02)
+            while epoch_step is not None and \
+                    self.epoch_store.get("step", -1) < epoch_step \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if epoch_step is None or \
+                    self.epoch_store.get("step", -1) < epoch_step:
+                self.outcome = "join-epoch-missing"
+                client.close()
+                return
+            self.params = np.asarray(self.epoch_store["params"],
+                                     np.float32).copy()
+            r = int(reply["round"])
+            client.activate(epoch_step)
+        try:
+            while r < self.rounds:
+                self.faults.frame(b"")
+                grad = self.rng.standard_normal(self.dim).astype(
+                    np.float32)
+                res = client.submit_round(
+                    r, grad, pidx=np.asarray([r % 16], np.int32),
+                    ptd=np.asarray([0.5], np.float32))
+                if res["status"] != RSTAT_OK:
+                    self.outcome = "fenced"
+                    self.dead_generation = client.generation
+                    client.close()
+                    return
+                self.members_seen.append(list(res["members"]))
+                if res["grad"] is not None:
+                    self.params = self.params - 0.1 * np.asarray(
+                        res["grad"], np.float32)
+                self.history[r] = self.params.copy()
+                if res.get("epoch_due") and res["members"] \
+                        and res["members"][0] == self.rid:
+                    # rank 0 commits the join-barrier "epoch" (the
+                    # shared dict stands in for the checkpoint store)
+                    self.epoch_store["step"] = r + 1
+                    self.epoch_store["params"] = self.params.copy()
+                    client.note_epoch(r, r + 1)
+                r += 1
+                if self.pace:
+                    time.sleep(self.pace)
+        except InjectedCrash:
+            # the kill drill: die WITHOUT releasing — the renewer dies
+            # with the 'process' and the lease must expire and fence
+            self.outcome = "killed"
+            self.dead_generation = client.generation
+            client.close()
+            return
+        except (ConnectionError, OSError) as e:
+            self.outcome = f"wire-lost: {e!r}"
+            client.close()
+            return
+        self.done_rounds.set()
+        if self.hold is not None:
+            self.hold.wait(30.0)
+        self.outcome = "done"
+        client.release()
+        client.close()
+
+
+def replica_soak(replicas: int = 2, rounds: int = 30, seed: int = 0,
+                 kill_at: Optional[int] = None,
+                 hang_at: Optional[int] = None,
+                 rejoin: bool = False, lease_s: float = 0.6,
+                 log_dir: Optional[str] = None, port: int = 0,
+                 verbose: bool = True) -> dict:
+    """The ISSUE-15 replica chaos drill: N synthetic replicas train a
+    toy model through the REAL gateway registry while the scripted
+    fault (kill or hang, via the production ``utils/faults.py`` plane)
+    removes one mid-run; with ``rejoin`` a replacement re-leases at a
+    new generation through the epoch barrier.  Verdict failures:
+
+    - **deadlock** — any replica thread alive at the join deadline;
+    - **divergent-params** — two live replicas disagree on the params
+      vector after any common round (the one-logical-model invariant);
+    - **unfenced-stale-write** — the killed replica's zombie submits a
+      stale-generation gradient and priority write-back; both must be
+      counted rejects, and the fencing counters must match EXACTLY;
+    - **expected-alert-never-fired / any-unexpected-alert / unresolved**
+      — the ``replica_degraded`` membership alert must fire during the
+      degraded window, resolve after the rejoin, and nothing else may
+      fire;
+    - **ledger mismatch** — every lease/round/fence counter on the
+      registry must equal the drill script's predicted value."""
+    from pytorch_distributed_tpu.config import (
+        AlertParams, MetricsParams, ReplicaParams,
+    )
+    from pytorch_distributed_tpu.parallel.dcn import (
+        ReplicaClient, ReplicaRegistry, RSTAT_FENCED, RSTAT_STALE,
+    )
+    from pytorch_distributed_tpu.utils import flight_recorder, telemetry
+    from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+
+    assert not (kill_at is not None and hang_at is not None), \
+        "pick ONE of --kill-replica / --hang-replica per drill"
+    fault_at = kill_at if kill_at is not None else hang_at
+    violations: List[str] = []
+
+    rules = (f"replica_degraded: replica/members < {replicas} for 0.3s; "
+             f"replica_churny: replica/generation_churn > 50 for 2s")
+    if log_dir:
+        flight_recorder.configure(log_dir, run_id="chaos-soak")
+    mission = telemetry.MissionControl(
+        log_dir, MetricsParams(enabled=True, poll_s=0.1),
+        AlertParams(rules=rules))
+    mission.start()
+    if log_dir:
+        reg_writer = MetricsWriter(log_dir, enable_tensorboard=False,
+                                   role="gateway", run_id="chaos-soak")
+    else:
+        reg_writer = _AggregatorWriter(mission.metrics)
+
+    registry = ReplicaRegistry(
+        ReplicaParams(replicas=replicas, lease_s=lease_s,
+                      join_timeout_s=15.0),
+        writer=reg_writer)
+    clock = GlobalClock()
+    store = ParamStore(8)
+    store.publish(np.zeros(8, dtype=np.float32))
+    gw = DcnGateway(store, clock, ActorStats(),
+                    put_chunk=lambda items: None, host="127.0.0.1",
+                    port=port, idle_deadline=30.0,
+                    health=lambda: mission.status_block(),
+                    replicas=registry)
+    addr = ("127.0.0.1", gw.port)
+
+    pace = 0.04
+    epoch_store: dict = {}
+    hold = threading.Event()
+    fleet = []
+    victim = replicas - 1  # the highest slot dies; rank 0 survives
+    for i in range(replicas):
+        spec = ""
+        if i == victim and fault_at is not None:
+            spec = (f"crash@{fault_at}" if kill_at is not None
+                    else f"hang@{fault_at}:{lease_s * 3:.2f}")
+        fleet.append(SyntheticReplica(
+            addr, i, replicas, rounds=rounds, pace=pace,
+            faults=(FaultInjector.scripted(spec, name=f"replica-{i}")
+                    if spec else None),
+            epoch_store=epoch_store, seed=seed, hold=hold).start())
+
+    deadline = time.monotonic() + max(30.0, rounds * pace * 3 + 25.0)
+    joiner = None
+    if rejoin and fault_at is not None:
+        # the replacement: spawned once the degraded window is live (so
+        # the membership alert has a dwell's worth of it to fire on),
+        # re-leases at a NEW generation and syncs through the
+        # join-barrier epoch — while the survivors are still training.
+        # Wait for FULL membership first: before the fleet finishes
+        # leasing, "degraded" is trivially true and a joiner spawned
+        # then would fence the still-live victim instead of replacing
+        # a dead one.
+        while time.monotonic() < deadline and \
+                len(registry.status_block()["members"]) < replicas:
+            time.sleep(0.02)
+        while time.monotonic() < deadline \
+                and not registry.status_block()["degraded"]:
+            time.sleep(0.05)
+        time.sleep(1.0)  # let the alert walk pending -> firing
+        joiner = SyntheticReplica(
+            addr, victim, replicas, rounds=rounds, pace=pace,
+            epoch_store=epoch_store, join=True, seed=seed,
+            hold=hold).start()
+
+    survivors = [rep for rep in fleet
+                 if fault_at is None or rep.rid != victim]
+    for rep in survivors + ([joiner] if joiner is not None else []):
+        if not rep.done_rounds.wait(max(0.1, deadline
+                                        - time.monotonic())):
+            violations.append(f"deadlock: replica {rep.rid} never "
+                              f"finished its rounds")
+    if fault_at is not None:
+        fleet[victim].thread.join(max(0.1, deadline - time.monotonic()))
+        if fleet[victim].thread.is_alive():
+            violations.append("deadlock: victim replica still running "
+                              "at the join deadline")
+
+    # ---- zombie leg: the dead replica's generation must be fenced —
+    # a stale gradient AND a stale priority write-back, both counted
+    stale_expected = 0
+    if fault_at is not None:
+        dead = fleet[victim]
+        dead_gen = dead.dead_generation
+        if dead_gen is None:
+            violations.append(
+                f"victim replica ended {dead.outcome!r} with no "
+                f"generation to test fencing with")
+        else:
+            zc = ReplicaClient(addr, victim)
+            zc.generation = dead_gen  # the zombie's stale credential
+            res = zc.submit_round(max(0, rounds - 1),
+                                  np.zeros(4, np.float32))
+            if res["status"] not in (RSTAT_FENCED, RSTAT_STALE):
+                violations.append(
+                    f"unfenced stale write: zombie gradient accepted "
+                    f"(status {res['status']})")
+            pres = zc.merge_prio(np.asarray([0], np.int32),
+                                 np.asarray([9.9], np.float32))
+            if pres.get("status") != "stale":
+                violations.append(
+                    f"unfenced stale write: zombie priority write-back "
+                    f"accepted ({pres})")
+            zc.close()
+            stale_expected = 1
+
+    # ---- alert verdict, read while the (recovered) membership still
+    # holds its leases: with a rejoin the degraded rule must have
+    # resolved by now; without one it legitimately stays firing
+    if rejoin and fault_at is not None:
+        end = time.monotonic() + 5.0
+        while time.monotonic() < end:
+            mission.poll()
+            snap = {a["rule"]: a for a in mission.engine.snapshot()}
+            dg = snap.get("replica_degraded", {})
+            if dg.get("fired_total", 0) > 0 \
+                    and dg.get("state") not in ("pending", "firing"):
+                break
+            time.sleep(mission.params.poll_s)
+    else:
+        time.sleep(3 * mission.params.poll_s + 0.2)
+    mission.poll()
+    alert_snap = mission.engine.snapshot()
+    hold.set()  # verdict read: finished replicas may release now
+    for rep in fleet + ([joiner] if joiner is not None else []):
+        rep.thread.join(10.0)
+    clock.stop.set()
+    mission.stop()
+    gw.close()
+
+    # ---- membership / params verdicts -------------------------------------
+    live = [rep for rep in fleet if rep.rid != victim
+            or fault_at is None]
+    for rep in live:
+        if rep.outcome != "done":
+            violations.append(f"replica {rep.rid} ended "
+                              f"{rep.outcome!r} (expected 'done')")
+    if fault_at is not None:
+        v = fleet[victim]
+        want = ("killed",) if kill_at is not None else ("fenced",)
+        if v.outcome not in want:
+            violations.append(f"victim replica ended {v.outcome!r} "
+                              f"(expected {want[0]!r})")
+        if rejoin and (joiner is None or joiner.outcome != "done"):
+            violations.append(
+                f"rejoined replica ended "
+                f"{joiner.outcome if joiner else 'never-spawned'!r}")
+    peers = list(fleet) + ([joiner] if joiner is not None else [])
+    for i, a in enumerate(peers):
+        for b in peers[i + 1:]:
+            common = sorted(set(a.history) & set(b.history))
+            for r in common:
+                if not np.array_equal(a.history[r], b.history[r]):
+                    violations.append(
+                        f"divergent params: replicas {a.rid}/{b.rid} "
+                        f"disagree after round {r}")
+                    break
+
+    # ---- exact-ledger verdict ---------------------------------------------
+    c = registry.status_block()["counters"]
+    expected_granted = replicas + (1 if (rejoin and joiner is not None)
+                                   else 0)
+    checks = [("leases_granted", expected_granted),
+              ("stale_prio_rejected", stale_expected),
+              ("joins_completed",
+               1 if (rejoin and joiner is not None
+                     and joiner.outcome == "done") else 0),
+              ("lease_fenced", 0),
+              ("joins_timed_out", 0)]
+    if fault_at is not None:
+        checks.append(("leases_expired", 1))
+        # the zombie's stale gradient is one counted grad reject; the
+        # hung victim's own post-expulsion submit is a second one
+        checks.append(("stale_grad_rejected",
+                       stale_expected + (1 if hang_at is not None
+                                         else 0)))
+    for name, want in checks:
+        if c.get(name) != want:
+            violations.append(f"ledger mismatch: {name} = "
+                              f"{c.get(name)} (expected {want})")
+    if fault_at is not None and registry.degraded_completions < 1:
+        violations.append("no degraded round completion was counted "
+                          "(the fault never bit)")
+
+    # ---- alert verdict (snapshot taken while membership was full) ----------
+    fired = sorted(a["rule"] for a in alert_snap
+                   if a["fired_total"] > 0)
+    unresolved = sorted(a["rule"] for a in alert_snap
+                        if a["state"] in ("pending", "firing"))
+    expected_alerts = (["replica_degraded"] if fault_at is not None
+                       else [])
+    unexpected = [r for r in fired if r not in expected_alerts]
+    if unexpected:
+        violations.append(f"unexpected alert(s) fired: {unexpected}")
+    for r in expected_alerts:
+        if r not in fired:
+            violations.append(f"expected alert {r!r} never fired "
+                              f"during the degraded window")
+    if rejoin and unresolved:
+        violations.append(f"alert(s) {unresolved} still unresolved "
+                          f"after the rejoin recovered membership")
+
+    report = {
+        "violations": violations,
+        "replicas": replicas,
+        "rounds": rounds,
+        "kill_at": kill_at,
+        "hang_at": hang_at,
+        "rejoin": rejoin,
+        "outcomes": {rep.rid: rep.outcome for rep in fleet},
+        "joiner_outcome": joiner.outcome if joiner is not None else None,
+        "counters": c,
+        "rounds_completed": registry.rounds_completed,
+        "degraded_completions": registry.degraded_completions,
+        "alerts": {"fired": fired, "unexpected": unexpected,
+                   "unresolved": unresolved},
+        "port": addr[1],
+    }
+    if log_dir:
+        reg_writer.close()
+        flight_recorder.dump_all("replica chaos drill complete")
+    if verbose:
+        for k, v in report.items():
+            if k != "violations":
+                print(f"[chaos] {k}: {v}")
+        for v in violations:
+            print(f"[chaos] VIOLATION: {v}")
+    return report
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools/chaos_soak.py",
@@ -818,6 +1260,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "while its neighbours pace normally — the "
                          "per-slot fairness drill (calm slots must get "
                          ">= 70%% of their rows through)")
+    ap.add_argument("--kill-replica", type=int, default=None,
+                    metavar="AT",
+                    help="replica drill (ISSUE 15): SIGKILL-equivalent "
+                         "crash of the highest replica at round AT "
+                         "(through the production REPLICA fault plane, "
+                         "utils/faults.py) — its lease must expire, the "
+                         "round must complete over the survivors within "
+                         "one lease window, the membership alert must "
+                         "fire, and the zombie's stale-generation "
+                         "writes must be counted rejects")
+    ap.add_argument("--hang-replica", type=int, default=None,
+                    metavar="AT",
+                    help="replica drill: freeze the highest replica's "
+                         "round loop at round AT while its lease "
+                         "renewer keeps renewing — the registry's "
+                         "round-stall rule must fence it (leases prove "
+                         "liveness, rounds prove progress)")
+    ap.add_argument("--rejoin", action="store_true",
+                    help="replica drill: after the kill/hang, a "
+                         "replacement re-leases at a NEW generation "
+                         "and syncs through the join-barrier epoch — "
+                         "membership must recover and the degraded "
+                         "alert must resolve")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="replica-drill fleet size")
+    ap.add_argument("--replica-rounds", type=int, default=30,
+                    help="rounds each surviving replica must complete")
     ap.add_argument("--log-dir", type=str, default=None,
                     help="leave the production artifact set (blackbox "
                          "rings with alert transitions, alert/* "
@@ -826,6 +1295,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="gateway port (0 = ephemeral); pin it so a "
                          "concurrent fleet_top can watch the soak")
     args = ap.parse_args(argv)
+    if args.kill_replica is not None or args.hang_replica is not None \
+            or args.rejoin:
+        kill_at = args.kill_replica
+        if kill_at is None and args.hang_replica is None:
+            kill_at = 8  # bare --rejoin: default kill-then-rejoin drill
+        report = replica_soak(
+            replicas=args.replicas, rounds=args.replica_rounds,
+            seed=args.seed, kill_at=kill_at,
+            hang_at=args.hang_replica, rejoin=args.rejoin,
+            log_dir=args.log_dir, port=args.port)
+        ok = not report["violations"]
+        print(f"[chaos] {'OK' if ok else 'FAILED'} replica drill: "
+              f"{len(report['violations'])} violations")
+        return 0 if ok else 1
     report = soak(seconds=args.seconds, actors=args.actors, seed=args.seed,
                   restart_every=args.restart_every or None,
                   reconnect_timeout=args.reconnect_timeout,

@@ -82,6 +82,23 @@ then
     exit 1
 fi
 
+echo "== stage 2c: replica smoke key (ISSUE 15) =="
+# the replica-plane overhead fraction must be present and sane — a
+# smoke run that silently dropped the leg would leave the multi-learner
+# plane's cost ungated (stage 3 then holds it under the 0.02 band)
+if ! python - "$tmp/smoke.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+v = d.get("replica_overhead", {}).get("replica_overhead_frac")
+assert isinstance(v, (int, float)) and 0 <= v, \
+    f"replica_overhead.replica_overhead_frac missing/invalid: {v!r}"
+print(f"replica_overhead.replica_overhead_frac = {v}")
+EOF
+then
+    echo "replica smoke key: FAIL"
+    exit 1
+fi
+
 echo "== stage 3: bench_gate vs BENCH_SMOKE_BASELINE.json =="
 # generous smoke tolerance: this stage pins the pipeline on any host;
 # same-machine perf gating uses the recorded history (TESTING.md)

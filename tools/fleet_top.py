@@ -274,6 +274,44 @@ def anakin_line(status: dict) -> Optional[str]:
     return "  anakin: " + " · ".join(bits)
 
 
+def replicas_line(status: dict) -> Optional[str]:
+    """One panel line for the ISSUE-15 multi-learner plane: the STATUS
+    ``replicas`` block (gateway ReplicaRegistry.status_block) — live
+    member count vs configured, generation counter, per-replica lease
+    age / round / updates-per-s, and the fencing ledger.  DEGRADED is
+    loud when the live membership is below the configured N."""
+    r = status.get("replicas")
+    if not r:
+        return None
+    members = r.get("members") or {}
+    expected = r.get("expected", len(members))
+    head = f"{len(members)}/{expected}"
+    if r.get("degraded"):
+        head += " DEGRADED"
+    bits = [head, f"gen {r.get('generation', 0)}"]
+    for rid, m in sorted(members.items(), key=lambda kv: int(kv[0])):
+        piece = (f"r{rid} gen{m.get('generation')} "
+                 f"lease {_fmt_age(m.get('lease_age'))} "
+                 f"rnd {m.get('round', -1)}")
+        ups = m.get("updates_per_s")
+        if ups is not None:
+            piece += f" {ups:g} up/s"
+        if m.get("joining"):
+            piece += " JOINING"
+        bits.append(piece)
+    c = r.get("counters") or {}
+    fenced = (c.get("stale_grad_rejected", 0)
+              + c.get("stale_prio_rejected", 0))
+    bits.append(f"rounds {r.get('rounds_completed', 0)}"
+                + (f" ({r.get('degraded_completions', 0)} degraded)"
+                   if r.get("degraded_completions") else ""))
+    if fenced or c.get("lease_fenced") or c.get("leases_expired"):
+        bits.append(f"fenced writes {fenced} · expired "
+                    f"{c.get('leases_expired', 0)} · evicted "
+                    f"{c.get('lease_fenced', 0)}")
+    return "  replicas: " + " · ".join(bits)
+
+
 def flow_line(status: dict) -> Optional[str]:
     """One panel line for the ISSUE-11 flow-control plane: the STATUS
     ``flow`` block (gateway GatewayFlow.status_block) — overload state
@@ -357,6 +395,9 @@ def render(status: dict,
     kline = anakin_line(status)
     if kline:
         lines.append(kline)
+    rline = replicas_line(status)
+    if rline:
+        lines.append(rline)
     alline = alerts_line(status)
     if alline:
         lines.append(alline)
