@@ -1440,6 +1440,128 @@ def bench_replica_overhead(rounds: int = 200, grad_dim: int = 65536,
     return {"replica_overhead": out}
 
 
+def bench_gateway_ha_overhead(chunks: int = 600, rows: int = 16,
+                              smoke: bool = False) -> dict:
+    """Gateway HA-plane cost on the ingest hot path (ISSUE 16
+    acceptance): a real DcnClient→DcnGateway wire ingest loop with the
+    HA plane ON (journaling its control state to a WAL) measures the
+    per-chunk ingest span, and the plane's adds are DIRECTLY timed in
+    isolation — the per-frame session gate (term check, rate-limited
+    TERM re-read amortized in), one fsynced journal ``append`` (paid
+    once per state window, never per chunk — charged at the measured
+    append count), and one primary-side sync-stream serve (charged at
+    the production sync_s cadence, standby or not).  The gate number
+    ``gateway_ha_overhead_frac`` is HA-work-per-chunk over
+    ingest-span-per-chunk, held under the 0.02 absolute band by
+    bench_gate — the PR-10 lesson applies verbatim: differencing an
+    HA-on wire rate against an HA-off one on this loaded host would
+    read scheduler hiccups as multi-% fake overhead, so the rate
+    difference is never the gate number.
+
+    ``smoke=True`` shrinks the loop to sub-second for CI; the
+    measurement logic is identical."""
+    import shutil
+    import tempfile
+
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.config import GatewayParams
+    from pytorch_distributed_tpu.parallel.dcn import (
+        DcnClient, DcnGateway, GatewayJournal, T_EXP,
+    )
+    from pytorch_distributed_tpu.utils.experience import Transition
+
+    gate_iters = 20_000
+    append_iters = 120
+    sync_iters = 4_000
+    if smoke:
+        chunks = min(chunks, 250)
+        gate_iters = 8_000
+        append_iters = 50
+        sync_iters = 1_500
+    gp = GatewayParams(enabled=True)  # production lease/sync defaults
+    tmp = tempfile.mkdtemp(prefix="bench-gw-ha-")
+    z = np.zeros(4, dtype=np.float32)
+    t = Transition(state0=z, action=np.int32(0), reward=np.float32(0.0),
+                   gamma_n=np.float32(0.99), state1=z,
+                   terminal1=np.float32(0.0))
+    chunk = [(t, 1.0)] * rows
+    store = ParamStore(4)
+    store.publish(np.zeros(4, dtype=np.float32))
+    gw = DcnGateway(store, GlobalClock(), ActorStats(),
+                    put_chunk=lambda items: None, host="127.0.0.1",
+                    port=0, gateway_params=gp, log_dir=tmp)
+    client = DcnClient(("127.0.0.1", gw.port), process_ind=0)
+    for _ in range(30):  # session + validator + allocator warmup
+        client.send_chunk(chunk)
+    appends_before = gw.status_snapshot()["gateway"]["journal_appends"]
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        client.send_chunk(chunk)
+    span = time.perf_counter() - t0
+    appends_during = (gw.status_snapshot()["gateway"]["journal_appends"]
+                      - appends_before)
+    # the plane's own work, timed directly: the per-frame gate...
+    t0 = time.perf_counter()
+    for _ in range(gate_iters):
+        gw._session_gate(T_EXP)
+    gate_s = time.perf_counter() - t0
+    # ...one fsynced state append against a second journal (same dir =
+    # same storage medium; the wire span above amortizes the SAME cost
+    # across every chunk in a state window)...
+    j = GatewayJournal(os.path.join(tmp, "direct"))
+    j.start_term(1)
+    state = {"tick_seq": {"0": 999}, "clock": {"learner_step": 10 ** 6,
+                                               "actor_step": 10 ** 7},
+             "chunks_in": 10 ** 6, "lost": 0,
+             "ledger": {"ingested": 10 ** 7, "shed": 0,
+                        "quarantined": 0}}
+    t0 = time.perf_counter()
+    for _ in range(append_iters):
+        j.append("state", state)
+    append_s = time.perf_counter() - t0
+    # ...and one primary-side sync serve (steady state: the standby's
+    # incremental pull finds the tail it already has)
+    t0 = time.perf_counter()
+    for _ in range(sync_iters):
+        base, recs = j.records_since(max(0, j.seq - 1))
+        json.dumps({"term": 1, "seq": j.seq, "base_seq": base,
+                    "records": recs})
+    sync_s_total = time.perf_counter() - t0
+    j.close()
+    client.close()
+    gw.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+    per_chunk = span / max(chunks, 1)
+    per_gate = gate_s / max(gate_iters, 1)
+    per_append = append_s / max(append_iters, 1)
+    per_sync = sync_s_total / max(sync_iters, 1)
+    # HA work charged per chunk: every frame pays the gate; the
+    # measured append count amortizes the fsync across the loop; the
+    # sync stream is charged at its production cadence over the span
+    ha_per_chunk = (per_gate
+                    + per_append * appends_during / max(chunks, 1)
+                    + per_sync * (span / max(gp.sync_s, 1e-3))
+                    / max(chunks, 1))
+    out = {
+        "chunks_per_sec_ingest": round(chunks / span, 1),
+        "chunk_ingest_us": round(per_chunk * 1e6, 2),
+        "gate_us_per_chunk": round(per_gate * 1e6, 3),
+        "journal_append_us": round(per_append * 1e6, 2),
+        "journal_appends_during": appends_during,
+        "sync_serve_us": round(per_sync * 1e6, 3),
+        # the gate number: per-chunk HA work / per-chunk ingest span
+        "gateway_ha_overhead_frac": round(ha_per_chunk / per_chunk, 4),
+        "chunk_rows": rows,
+        "geometry": "smoke-wire" if smoke else "wire",
+    }
+    print(f"[bench_gateway_ha_overhead] {out}", file=sys.stderr,
+          flush=True)
+    return {"gateway_ha_overhead": out}
+
+
 def bench_smoke(updates: int = 384) -> dict:
     """Seconds-scale, CPU-safe bench for CI gating (ISSUE 6 satellite):
     the dqn-mlp learner program fused over a small uniform HBM-style
@@ -2177,7 +2299,8 @@ def main() -> None:
                                        "sampler", "act", "actor",
                                        "health", "perf", "device_env",
                                        "provenance", "metrics", "flow",
-                                       "anakin", "replica"),
+                                       "anakin", "replica",
+                                       "gateway"),
                     default="both")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale CPU-safe bench (the dqn-mlp "
@@ -2227,6 +2350,11 @@ def main() -> None:
         # stamp vs the round-exchange span): additive key, schema
         # stays 4; tools/check.sh stage 2c fails on its absence
         result.update(bench_replica_overhead(smoke=True))
+        # ISSUE-16 gateway HA-plane overhead (journal append + sync
+        # serve + per-frame term gate vs the wire ingest span):
+        # additive key, schema stays 4; tools/check.sh stage 2d fails
+        # on its absence
+        result.update(bench_gateway_ha_overhead(smoke=True))
         # ISSUE-12 co-located loop: the closed rollout+learn pair rate
         # on a tiny fleet (additive key, schema stays 4; the full
         # section with the split-process comparison runs under --mode
@@ -2266,6 +2394,8 @@ def main() -> None:
         result.update(bench_flow_overhead())
     if args.mode in ("both", "replica"):
         result.update(bench_replica_overhead())
+    if args.mode in ("both", "gateway"):
+        result.update(bench_gateway_ha_overhead())
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))

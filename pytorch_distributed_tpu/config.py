@@ -120,6 +120,10 @@ KNOBS = (
      "per-field ReplicaParams overrides — the ISSUE-15 multi-learner "
      "replica plane (e.g. TPU_APEX_REPLICA_REPLICAS, "
      "TPU_APEX_REPLICA_LEASE_S)"),
+    ("TPU_APEX_GATEWAY_*", "parallel/dcn.py",
+     "per-field GatewayParams overrides — the ISSUE-16 gateway "
+     "high-availability plane (e.g. TPU_APEX_GATEWAY_ENABLED, "
+     "TPU_APEX_GATEWAY_LEASE_S, TPU_APEX_GATEWAY_ENDPOINTS)"),
 )
 
 
@@ -712,6 +716,51 @@ class ReplicaParams:
 
 
 @dataclass
+class GatewayParams:
+    """Gateway high-availability plane knobs (ISSUE 16;
+    parallel/dcn.py DcnGateway HA role / GatewayJournal — no reference
+    equivalent: the reference's single mp.Queue hub dies with the
+    learner process).  Every field is env-overridable as
+    ``TPU_APEX_GATEWAY_<FIELD>`` via ``parallel.dcn.resolve_gateway``,
+    the same spawn-inheritance contract the health/perf/flow/replica
+    planes use.
+
+    The primary gateway journals its mutable control state (slot
+    incarnations, tick dedup high-waters, cumulative flow ledgers,
+    clock counters) to an append-only fsynced WAL under
+    ``{log_dir}/gateway/`` and serves it to a warm standby over the
+    sessionless ``T_SYNC`` verb.  Primary and standby carry a
+    monotonic *term* (the PR-14 replica-generation pattern lifted one
+    level up) persisted in ``TERM.json`` on the SHARED log_dir — the
+    same shared-storage requirement checkpoint resume already has.
+    The standby promotes when the primary goes silent for one lease
+    window; a resurrected stale-term primary fences itself against the
+    on-disk term and its writes are counted rejects
+    (``gateway_term_fenced``), never applied.  With ``enabled`` False
+    (the default) no journal is written, STATUS carries no ``gateway``
+    block and the wire is byte-identical to the pre-HA protocol."""
+
+    # Master switch.  Off = the single-gateway topology of PRs 1-15,
+    # bit-for-bit: no term, no WAL, no sync verb traffic.
+    enabled: bool = False
+    # Primary lease window, seconds: the standby promotes once it has
+    # failed to sync for this long.  Also bounds how long a fenced
+    # primary can run before noticing the on-disk term moved.
+    lease_s: float = 2.0
+    # Standby sync cadence, seconds (journal records are pulled with
+    # sessionless T_SYNC requests at this rate; sync lag on STATUS is
+    # quantized by it).
+    sync_s: float = 0.25
+    # Standby bind ``host:port`` for fleet.py --role gateway-standby
+    # ("" = 0.0.0.0 on an ephemeral port).
+    standby: str = ""
+    # Ordered client dial list ``host:port,host:port`` (primary first).
+    # Exported to spawned actors so DcnClient redials the next endpoint
+    # on terminal disconnect.  "" = single-endpoint (pre-HA) dialing.
+    endpoints: str = ""
+
+
+@dataclass
 class LearnerPerfParams:
     """MFU-campaign knobs (ISSUE 13; no reference equivalent — the
     reference never measures device utilization at all).  Every field
@@ -833,6 +882,7 @@ class Options:
     learner_perf_params: LearnerPerfParams = field(
         default_factory=LearnerPerfParams)
     replica_params: ReplicaParams = field(default_factory=ReplicaParams)
+    gateway_params: GatewayParams = field(default_factory=GatewayParams)
 
     @property
     def model_dir(self) -> str:
@@ -927,7 +977,8 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
                     "agent_params", "parallel_params", "health_params",
                     "perf_params", "metrics_params", "alert_params",
                     "flow_params", "anakin_params",
-                    "learner_perf_params", "replica_params"):
+                    "learner_perf_params", "replica_params",
+                    "gateway_params"):
             subobj = getattr(opt, sub)
             if hasattr(subobj, key):
                 hits.append((sub, subobj))

@@ -142,7 +142,15 @@ class FleetTopology(Topology):
             # the flight-recorder leg only
             flow_writer=(self.mission._writer
                          if self.mission is not None else None),
-            replicas=self.replica_registry)
+            replicas=self.replica_registry,
+            # gateway HA plane (ISSUE 16): resolved by Topology.__init__
+            # (and exported to spawn children); with the plane off the
+            # extra kwargs are inert and the gateway is byte-identical
+            gateway_params=self.gateway_ha,
+            log_dir=(self.opt.log_dir if self.gateway_ha.enabled
+                     else None),
+            ha_writer=(self.mission._writer
+                       if self.mission is not None else None))
 
     def _flow_pressure(self) -> float:
         """The overload governor's input signal: ingest-queue
@@ -420,6 +428,92 @@ def run_replica_host(opt: Options, coordinator: str,
 
 
 # ---------------------------------------------------------------------------
+# gateway standby host (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def run_gateway_standby(opt: Options, coordinator: str,
+                        port: int = 0) -> None:
+    """``--role gateway-standby``: a warm standby gateway for the HA
+    plane (parallel/dcn.py, GatewayParams).  It pulls the primary's
+    journaled control plane over sessionless T_SYNC, refuses session
+    verbs (counted) until the primary's lease expires, then PROMOTES:
+    CAS-bumps the term on the SHARED ``{log_dir}/gateway/`` dir — the
+    same shared-storage requirement checkpoint resume already has — and
+    starts serving, fencing any resurrected predecessor.
+
+    The standby hosts its own param store/clock/stats and spools
+    promoted-era experience into a bounded drop-oldest buffer (counted)
+    — control-plane continuity that keeps actors alive and accounted
+    while an orchestrator restarts a full learner host against the
+    checkpoint store; it does not itself train.  SIGTERM drains and
+    exits 0 like every other host role."""
+    import collections
+
+    from pytorch_distributed_tpu.factory import (
+        build_model, init_params, probe_env,
+    )
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.parallel.dcn import (
+        DcnGateway, parse_endpoints, resolve_gateway,
+    )
+    from pytorch_distributed_tpu.utils import flight_recorder
+    from pytorch_distributed_tpu.utils.helpers import tree_size
+
+    gp = resolve_gateway(opt.gateway_params)
+    if not gp.enabled:
+        raise SystemExit(
+            "--role gateway-standby needs the HA plane on: set "
+            "TPU_APEX_GATEWAY_ENABLED=1 (or opt.gateway_params.enabled)")
+    flight_recorder.configure(opt.log_dir, run_id=opt.refs)
+    spec = probe_env(opt)
+    store = ParamStore(tree_size(init_params(
+        opt, spec, build_model(opt, spec), seed=opt.seed)))
+    clock = GlobalClock()
+    spool: collections.deque = collections.deque(maxlen=4096)
+    spooled = [0]
+
+    def _spool(items: list) -> None:
+        spool.append(items)
+        spooled[0] += len(items)
+
+    bind_host, bind_port = "0.0.0.0", port
+    if gp.standby:
+        eps = parse_endpoints(gp.standby)
+        if eps:
+            bind_host, bind_port = eps[0]
+    primary = parse_endpoints(coordinator)[0]
+    gw = DcnGateway(store, clock, ActorStats(), put_chunk=_spool,
+                    host=bind_host, port=bind_port,
+                    gateway_params=gp, log_dir=opt.log_dir,
+                    ha_role="standby", sync_from=primary)
+    # SIGTERM drain flag: a plain threading.Event polled around an
+    # interruptible sleep (the run_fleet_actors pattern) — the handler
+    # must NOT take the mp clock lock the main thread would be parked
+    # on inside ``clock.stop.wait`` (signal-handler self-deadlock)
+    host_stop = threading.Event()
+    if threading.current_thread() is threading.main_thread():
+        try:
+            signal.signal(signal.SIGTERM,
+                          lambda s, f: host_stop.set())
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+    print(f"[fleet] gateway standby up on port {gw.port}, syncing "
+          f"{primary[0]}:{primary[1]} (lease {gp.lease_s:g}s)")
+    try:
+        while not host_stop.is_set() and not clock.stop.is_set():
+            time.sleep(0.5)
+    finally:
+        role = gw.status_snapshot().get("gateway", {})
+        gw.close()
+        print(f"[fleet] gateway standby exiting: role "
+              f"{role.get('role')!r} term {role.get('term')} "
+              f"(spooled {spooled[0]} rows post-promotion)")
+
+
+# ---------------------------------------------------------------------------
 # actor host
 # ---------------------------------------------------------------------------
 
@@ -450,10 +544,17 @@ def _remote_actor_main(opt: Options, coordinator: str, process_ind: int,
 
     flight_recorder.configure(opt.log_dir, run_id=opt.refs)
     recorder = flight_recorder.get_recorder(f"actor-{process_ind}")
-    host, port = coordinator.rsplit(":", 1)
+    # ``--coordinator`` accepts an ORDERED endpoint list
+    # ("primary:5555,standby:5556") when the gateway HA plane is on
+    # (ISSUE 16): the client dials in order and fails over to the
+    # promoted standby on terminal disconnect.  A plain host:port is
+    # the unchanged single-gateway contract.
+    from pytorch_distributed_tpu.parallel.dcn import parse_endpoints
+
+    endpoints = parse_endpoints(coordinator)
     recorder.record("session-start", coordinator=coordinator)
     try:
-        client = DcnClient((host, int(port)), process_ind=process_ind)
+        client = DcnClient(endpoints, process_ind=process_ind)
     except (ConnectionError, OSError, DcnRefused) as e:
         # no session was ever established (gateway unreachable, or the
         # HELLO was refused — slot conflict): still a network/learner-host
@@ -538,8 +639,12 @@ def run_fleet_actors(opt: Options, coordinator: str, actor_base: int,
     pusher = None
     mparams = telemetry.resolve_metrics(opt.metrics_params)
     if mparams.enabled:
-        phost, pport = coordinator.rsplit(":", 1)
-        pusher = telemetry.MetricsPusher((phost, int(pport)),
+        from pytorch_distributed_tpu.parallel.dcn import parse_endpoints
+
+        # the pusher pins the FIRST endpoint; its sessionless push has
+        # per-call timeouts + a single retry (parallel/dcn.py), so a
+        # promotion window costs dropped batches, not a wedged thread
+        pusher = telemetry.MetricsPusher(parse_endpoints(coordinator)[0],
                                          opt.log_dir, mparams)
         pusher.start()
 
@@ -740,7 +845,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         prog="pytorch_distributed_tpu.fleet",
         description="multi-host Ape-X fleet launcher")
     ap.add_argument("--role",
-                    choices=("learner", "actors", "learner-replica"),
+                    choices=("learner", "actors", "learner-replica",
+                             "gateway-standby"),
                     required=True)
     ap.add_argument("--replica-id", type=int, default=1,
                     help="[learner-replica] this host's replica id "
@@ -753,7 +859,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     ap.add_argument("--local-actors", type=int, default=0,
                     help="[learner] actors co-located on the learner host")
     ap.add_argument("--coordinator", type=str, default=None,
-                    help="[actors] learner host as host:port")
+                    help="[actors|gateway-standby] learner host as "
+                         "host:port; actor hosts may give a comma list "
+                         "'h1:p1,h2:p2' (primary first, standby after) "
+                         "and fail over between them (ISSUE 16)")
     ap.add_argument("--actor-base", type=int, default=0,
                     help="[actors] first global actor slot on this host")
     ap.add_argument("--actor-count", type=int, default=8,
@@ -839,6 +948,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     elif args.role == "learner-replica":
         assert args.coordinator, "--coordinator host:port required"
         run_replica_host(opt, args.coordinator, args.replica_id)
+    elif args.role == "gateway-standby":
+        assert args.coordinator, "--coordinator host:port required"
+        run_gateway_standby(opt, args.coordinator, args.port)
     else:
         assert args.coordinator, "--coordinator host:port required"
         abandoned = run_fleet_actors(opt, args.coordinator, args.actor_base,

@@ -113,6 +113,7 @@ probes (``fetch_status``; rendered by tools/fleet_top.py).
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import os
@@ -174,6 +175,18 @@ T_RPRIO = 14    # savez out-of-round |TD| priority write-back -> JSON
 #                reply; stale-generation writes are counted rejects
 #                (last-generation-wins fencing: a zombie replica can
 #                never resurrect stale priorities)
+T_SYNC = 15     # JSON {since} -> JSON {term, seq, base_seq, records,
+#                wall}: the gateway HA control-plane stream (ISSUE 16).
+#                Sessionless like T_STATUS and outside the wire fault
+#                plane: the warm standby pulls journal records past its
+#                applied offset on its sync cadence; records are
+#                ABSOLUTE state snapshots (idempotent to re-apply), so
+#                a standby that restarts mid-sync can resync from any
+#                offset without double-counting ledger entries.  Only
+#                an HA primary answers with records; everyone else
+#                replies with an ``error`` key — the verb is never sent
+#                unless the HA plane is on, keeping the pre-HA wire
+#                byte-identical.
 
 _MAX_FRAME = 1 << 31  # 2 GiB — far above any chunk; rejects garbage lengths
 
@@ -367,6 +380,301 @@ def export_replica_env(rp) -> None:
         if val != f.default:
             os.environ.setdefault("TPU_APEX_REPLICA_" + f.name.upper(),
                                   str(val))
+
+
+# ---------------------------------------------------------------------------
+# gateway high availability (ISSUE 16): durable control plane + warm-standby
+# failover with fenced promotion
+# ---------------------------------------------------------------------------
+
+def resolve_gateway(gp=None):
+    """GatewayParams + ``TPU_APEX_GATEWAY_<FIELD>`` env overrides — the
+    same override-by-env contract as the health/perf/flow/replica
+    planes.  Returns a NEW instance; the input is never mutated."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.config import GatewayParams
+
+    if gp is None:
+        gp = GatewayParams()
+    changes: Dict[str, Any] = {}
+    for f in dataclasses.fields(gp):
+        raw = os.environ.get("TPU_APEX_GATEWAY_" + f.name.upper())
+        if raw is None:
+            continue
+        cur = getattr(gp, f.name)
+        if isinstance(cur, bool):
+            changes[f.name] = raw.strip().lower() not in (
+                "0", "false", "off", "no", "")
+        elif isinstance(cur, int) and not isinstance(cur, bool):
+            changes[f.name] = int(float(raw))
+        elif isinstance(cur, float):
+            changes[f.name] = float(raw)
+        else:
+            changes[f.name] = raw.strip()
+    return dataclasses.replace(gp, **changes) if changes else gp
+
+
+def export_gateway_env(gp) -> None:
+    """Export a RESOLVED GatewayParams into the environment so spawn
+    children (remote actor mains, the standby runner) resolve the same
+    HA plane the topology configured.  setdefault: an operator's
+    explicit env wins."""
+    import dataclasses
+
+    for f in dataclasses.fields(gp):
+        val = getattr(gp, f.name)
+        if val != f.default:
+            os.environ.setdefault("TPU_APEX_GATEWAY_" + f.name.upper(),
+                                  str(val))
+
+
+def parse_endpoints(spec) -> List[Tuple[str, int]]:
+    """``host:port,host:port`` (or a ready-made address/list) -> ordered
+    endpoint list for DcnClient failover dialing.  IPv6 is out of scope
+    for the fleet CLI (matching fleet.py's coordinator parsing)."""
+    if not spec:
+        return []
+    if isinstance(spec, (list, tuple)):
+        if (len(spec) == 2 and isinstance(spec[0], str)
+                and isinstance(spec[1], int)):
+            return [(spec[0], int(spec[1]))]  # a single ("host", port)
+        out: List[Tuple[str, int]] = []
+        for item in spec:
+            if isinstance(item, str):
+                out.extend(parse_endpoints(item))
+            else:
+                h, p = item
+                out.append((h, int(p)))
+        return out
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+def _rec_digest(seq: int, kind: str, data: Dict[str, Any]) -> str:
+    """Per-record WAL digest: seq|kind|canonical-json, first 12 hex of
+    sha256 — enough to catch torn/bit-rotted lines, cheap to verify on
+    every recovery scan."""
+    blob = f"{seq}|{kind}|{json.dumps(data, sort_keys=True)}"
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+class GatewayJournal:
+    """Append-only fsynced WAL for the gateway's mutable control state
+    (ISSUE 16) under ``{log_dir}/gateway/`` — the same shared-storage,
+    atomic-rename + digest discipline as the PR-2 checkpoint epochs.
+
+    Layout::
+
+        {log_dir}/gateway/TERM.json          # {"term", "wall", "sha"}
+        {log_dir}/gateway/wal-<term>.jsonl   # one JSON record per line
+        {log_dir}/gateway/standby/wal-0.jsonl  # standby's applied copy
+
+    ``TERM.json`` is the fencing substrate: it is only ever replaced
+    atomically (tmp + ``os.replace``) with a strictly larger term, and
+    every HA gateway re-reads it (mtime-gated) before applying writes —
+    a resurrected primary whose term is below the on-disk term fences
+    itself.  Each WAL line is ``{"seq", "kind", "data", "sha"}`` with a
+    per-record digest; recovery scans the newest term's file, SKIPS a
+    torn/undigestable trailing record (the ``read_scalars`` discipline)
+    and falls back to a counted clean slate on an empty/corrupt journal
+    — torn state is never fatal, only warm-start warmth is lost.
+    Records carry ABSOLUTE values (cumulative ledgers, incarnation and
+    seq high-waters), so applying any suffix — or the whole file twice —
+    is idempotent by construction."""
+
+    def __init__(self, root: str, standby: bool = False):
+        self.dir = os.path.join(root, "gateway")
+        if standby:
+            # the standby journals its APPLIED copy of the stream in a
+            # subdir so it never touches the primary's term WAL; on the
+            # shared log_dir both survive either host
+            self.dir = os.path.join(self.dir, "standby")
+        os.makedirs(self.dir, exist_ok=True)
+        self._standby = standby
+        self._lock = threading.Lock()
+        self._fh = None
+        self.term = 0          # term this journal is appending under
+        self.seq = 0           # last appended/applied record seq
+        self.base_seq = 0      # first seq held in the in-memory tail
+        self.appends = 0
+        self.recover_warnings = 0
+        # in-memory tail served over T_SYNC; bounded — a standby that
+        # falls further behind than this gets base_seq back and re-pulls
+        # from there (records are idempotent, so the overlap is safe)
+        self._tail: List[Dict[str, Any]] = []
+        self._tail_max = 65536
+
+    # -- term file (the fencing substrate) --------------------------------
+
+    def _term_path(self) -> str:
+        # the term file always lives at the SHARED top-level gateway dir
+        # (even for the standby journal, which writes it on promotion)
+        d = os.path.dirname(self.dir) if self._standby else self.dir
+        return os.path.join(d, "TERM.json")
+
+    def read_term(self) -> int:
+        """Digest-checked read of the on-disk term; torn/corrupt/missing
+        reads as 0 with a counted warning (never fatal — a gateway that
+        cannot prove a HIGHER term exists keeps leading)."""
+        try:
+            with open(self._term_path()) as fh:
+                doc = json.load(fh)
+            term = int(doc["term"])
+            want = _rec_digest(term, "term", {"wall": doc["wall"]})
+            if doc.get("sha") != want:
+                self.recover_warnings += 1
+                return 0
+            return term
+        except FileNotFoundError:
+            return 0
+        except Exception:
+            self.recover_warnings += 1
+            return 0
+
+    def write_term(self, term: int) -> None:
+        """Atomically publish a new (strictly larger) term — tmp +
+        ``os.replace``, digest-stamped, fsynced before the rename so a
+        torn publish can never read as valid."""
+        path = self._term_path()
+        wall = time.time()
+        doc = {"term": int(term), "wall": wall,
+               "sha": _rec_digest(int(term), "term", {"wall": wall})}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    # -- the WAL itself ---------------------------------------------------
+
+    def _wal_path(self, term: int) -> str:
+        return os.path.join(self.dir, f"wal-{term:08d}.jsonl")
+
+    def start_term(self, term: int) -> None:
+        """Open (append mode) the WAL for ``term``; subsequent appends
+        land there.  seq continues from whatever recover() found so the
+        (term, seq) pair is globally monotonic."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+            self.term = int(term)
+            self._fh = open(self._wal_path(self.term), "a")
+
+    def append(self, kind: str, data: Dict[str, Any]) -> int:
+        """fsynced append of one control record; returns its seq.
+        Raises OSError if the backing store is gone — the gateway treats
+        a failed append as self-fencing (can't journal => can't lead)."""
+        with self._lock:
+            if self._fh is None:
+                raise OSError("journal not open")
+            self.seq += 1
+            rec = {"seq": self.seq, "kind": kind, "data": data,
+                   "sha": _rec_digest(self.seq, kind, data)}
+            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.appends += 1
+            self._tail.append(rec)
+            if len(self._tail) > self._tail_max:
+                drop = len(self._tail) - self._tail_max
+                del self._tail[:drop]
+            self.base_seq = self._tail[0]["seq"] if self._tail else self.seq
+            return self.seq
+
+    def apply(self, rec: Dict[str, Any]) -> bool:
+        """Standby side: persist one pulled record verbatim (same seq
+        numbering as the primary) and advance the applied offset.
+        Already-applied seqs are ignored — the resync overlap after a
+        standby restart is a no-op, not a double-count."""
+        seq = int(rec.get("seq", 0))
+        with self._lock:
+            if seq <= self.seq:
+                return False
+            if self._fh is None:
+                self._fh = open(self._wal_path(0), "a")
+            self.seq = seq
+            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.appends += 1
+            self._tail.append(rec)
+            if len(self._tail) > self._tail_max:
+                del self._tail[:len(self._tail) - self._tail_max]
+            self.base_seq = self._tail[0]["seq"] if self._tail else self.seq
+            return True
+
+    def records_since(self, since: int) -> Tuple[int, List[Dict[str, Any]]]:
+        """(base_seq, records with seq > since) from the in-memory tail —
+        the T_SYNC reply body.  A ``since`` below base_seq gets the whole
+        tail (idempotent records make the overlap harmless)."""
+        with self._lock:
+            recs = [r for r in self._tail if r["seq"] > since]
+            return self.base_seq, recs
+
+    def recover(self) -> Tuple[int, List[Dict[str, Any]]]:
+        """Scan this journal dir newest-term-first and return
+        ``(term, records)`` of the first file that yields any valid
+        records — digest-verifying every line, skipping a torn or
+        undigestable TRAILING record, and counting (never raising) a
+        clean-slate fallback on empty/corrupt journals."""
+        try:
+            names = sorted((n for n in os.listdir(self.dir)
+                            if n.startswith("wal-")
+                            and n.endswith(".jsonl")), reverse=True)
+        except OSError:
+            self.recover_warnings += 1
+            return 0, []
+        top_term = max((int(n[len("wal-"):-len(".jsonl")]) for n in names),
+                       default=0)
+        for name in names:
+            recs: List[Dict[str, Any]] = []
+            torn = 0
+            try:
+                with open(os.path.join(self.dir, name)) as fh:
+                    lines = fh.read().split("\n")
+            except OSError:
+                self.recover_warnings += 1
+                continue
+            for line in lines:
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                    if rec.get("sha") != _rec_digest(
+                            int(rec["seq"]), rec["kind"], rec["data"]):
+                        raise ValueError("digest mismatch")
+                except Exception:
+                    torn += 1
+                    continue
+                recs.append(rec)
+            if torn:
+                self.recover_warnings += torn
+            if recs:
+                with self._lock:
+                    self.seq = max(int(r["seq"]) for r in recs)
+                    self._tail = recs[-self._tail_max:]
+                    self.base_seq = self._tail[0]["seq"]
+                # the TERM floor is the newest file seen even when that
+                # file itself was empty — a bump can never collide
+                return top_term, recs
+            if name == names[0]:
+                # newest journal empty/corrupt: counted clean slate
+                self.recover_warnings += 1
+        return top_term, []
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
 
 # the in-process registry handle: FleetTopology sets it at construction
@@ -1439,7 +1747,13 @@ class DcnGateway:
                  flow_params=None,
                  pressure: Optional[Callable[[], float]] = None,
                  flow_writer=None,
-                 replicas: Optional[ReplicaRegistry] = None):
+                 replicas: Optional[ReplicaRegistry] = None,
+                 gateway_params=None,
+                 log_dir: Optional[str] = None,
+                 ha_role: str = "primary",
+                 sync_from: Optional[Tuple[str, int]] = None,
+                 ha_writer=None,
+                 resume_term: Optional[int] = None):
         self.param_store = param_store
         self.clock = clock
         self.actor_stats = actor_stats
@@ -1505,6 +1819,49 @@ class DcnGateway:
         self.frames_rejected = 0
         self.quarantined: Dict[str, int] = {}
         self._validators: Dict[str, Any] = {}
+        # gateway HA plane (ISSUE 16): durable control journal + warm
+        # standby + fenced promotion.  Entirely absent unless a resolved
+        # GatewayParams enables it AND a log_dir exists to journal under
+        # — the default single-gateway fleet stays byte-identical on the
+        # wire (no term/sync fields, no TERM/WAL files, no STATUS block).
+        self._gp = resolve_gateway(gateway_params)
+        self._ha = bool(self._gp.enabled and log_dir)
+        self._ha_log_dir = log_dir
+        self._role = ("standby" if (self._ha and ha_role == "standby")
+                      else "primary")
+        # a standby refuses session verbs (counted) until promoted, so
+        # failing-over clients land on the ConnectionError -> redial
+        # path, never the terminal DcnRefused path
+        self._serving = not (self._ha and self._role == "standby")
+        self._sync_from = sync_from
+        self._ha_writer = ha_writer
+        self.term = 0
+        self.promotions = 0
+        self.gateway_term_fenced = 0  # writes rejected on a stale term
+        self.standby_refused = 0
+        self.failover_lost = 0  # acked-but-undrained rows lost in failover
+        self.sync_served = 0
+        self.promoted = threading.Event()
+        self._term_fenced = False
+        self._journal_dead = False
+        self._term_checked = 0.0
+        # re-read TERM.json at most this often on the write path: bounds
+        # how long a fenced primary can run before noticing, well inside
+        # the lease window that gates any promotion in the first place
+        self._term_check_every = min(0.05, max(0.01, self._gp.lease_s / 10))
+        self._journal: Optional[GatewayJournal] = None
+        # absolute ingest totals carried across terms (seeded from the
+        # journal / sync stream; own-plane counters add on top)
+        self._ha_carry: Dict[str, int] = {}
+        self._inc_floor: Dict[int, int] = {}  # journal-seeded slot fencing
+        self._ha_thread: Optional[threading.Thread] = None
+        self._ha_state_every = max(0.05, min(0.5, self._gp.sync_s))
+        self._ha_state_last = 0.0
+        self._sync_seq = 0
+        self._sync_term = 0
+        self._last_sync_ok = time.monotonic()
+        if self._ha:
+            self._ha_init(resume_term)
         # all state above must exist before the first connection lands
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="dcn-accept", daemon=True)
@@ -1554,6 +1911,287 @@ class DcnGateway:
             if tier:
                 msg["brownout"] = tier
         return json.dumps(msg).encode()
+
+    # -- gateway HA plane (ISSUE 16) ----------------------------------------
+
+    def _ha_init(self, resume_term: Optional[int]) -> None:
+        """Role-split HA bring-up.  Primary: recover the journal, bump +
+        publish the term, warm-seed tick dedup / incarnation floors /
+        ledger carry from the recovered records.  Standby: recover its
+        own applied-copy journal (the resync offset) and start the sync
+        loop.  ``resume_term`` is the drill hook for a RESURRECTED
+        primary: it believes the stale term it is given and must
+        discover the on-disk one through the fencing path — it never
+        bumps, never writes TERM.json, never opens a WAL."""
+        if self._role == "standby":
+            self._journal = GatewayJournal(self._ha_log_dir, standby=True)
+            _term, recs = self._journal.recover()
+            self._seed_records(recs)
+            self._sync_seq = self._journal.seq
+            self._ha_thread = threading.Thread(
+                target=self._ha_loop, name="dcn-ha-sync", daemon=True)
+            self._ha_thread.start()
+            return
+        self._journal = GatewayJournal(self._ha_log_dir)
+        if resume_term is not None:
+            self.term = int(resume_term)
+            return
+        disk = self._journal.read_term()
+        rec_term, recs = self._journal.recover()
+        self.term = max(disk, rec_term) + 1
+        self._journal.write_term(self.term)
+        self._journal.start_term(self.term)
+        self._seed_records(recs)
+        self._ha_append("start", {"term": self.term})
+        self._recorder.record("gateway-term", term=self.term,
+                              warm=len(recs))
+
+    def _ha_append(self, kind: str, data: Dict[str, Any]) -> None:
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(kind, data)
+        except OSError:
+            # can't journal => can't lead: losing the shared log dir is
+            # indistinguishable from being the partitioned side of a
+            # split brain, so writes self-fence from here on (counted
+            # per rejected frame in gateway_term_fenced)
+            self._journal_dead = True
+
+    def _ha_write_ok(self) -> bool:
+        """May this gateway still apply session writes?  False once a
+        HIGHER term is visible on disk (a standby promoted over us) or
+        our own journal died — the structural split-brain guarantee."""
+        if self._term_fenced or self._journal_dead:
+            return False
+        now = time.monotonic()
+        if now - self._term_checked >= self._term_check_every:
+            self._term_checked = now
+            disk = self._journal.read_term() if self._journal else 0
+            if disk > self.term:
+                self._term_fenced = True
+                self._recorder.record("gateway-fenced",
+                                      term=self.term, disk=disk)
+                print(f"[dcn] gateway term {self.term} fenced by "
+                      f"on-disk term {disk}", flush=True)
+                return False
+        return True
+
+    def _session_gate(self, ftype: int) -> None:
+        """Pre-dispatch HA gate for SESSION verbs only (sessionless
+        probes always answer).  An unpromoted standby refuses with a
+        counted connection drop — the client's redial path then cycles
+        to the next endpoint, never the terminal DcnRefused path — and
+        a fenced stale-term gateway's writes/grants are counted rejects
+        that are NEVER applied."""
+        if ftype in (T_STATUS, T_PROFILE, T_METRICS, T_RLEASE,
+                     T_RGRAD, T_RPRIO, T_SYNC, T_BYE):
+            return
+        if not self._serving:
+            self.standby_refused += 1
+            raise ConnectionError(
+                "standby gateway: sessions refused before promotion")
+        if not self._ha_write_ok():
+            self.gateway_term_fenced += 1
+            self._recorder.record("stale-term-write",
+                                  ftype=ftype, term=self.term)
+            raise ConnectionError("gateway term fenced")
+
+    def _ha_ledger(self) -> Dict[str, int]:
+        """ABSOLUTE cumulative ingest-side totals across terms: the
+        journal carry (what previous terms accounted) plus this
+        process's own counters — what the state records persist and the
+        sync stream ships, so re-applying any suffix is idempotent."""
+        led = {"ingested": int(self._ha_carry.get("ingested", 0)),
+               "shed": int(self._ha_carry.get("shed", 0)),
+               "quarantined": int(self._ha_carry.get("quarantined", 0))}
+        if self._flow is not None:
+            led["ingested"] += int(self._flow.ingested_rows)
+            led["shed"] += int(sum(self._flow.shed_rows.values()))
+        with self._slots_lock:
+            led["quarantined"] += int(sum(self.quarantined.values()))
+        return led
+
+    def _ha_note_state(self) -> None:
+        """Rate-limited composite state record on the serve path: tick
+        dedup high-waters, clock counters, the cumulative ledger and the
+        failover-lost count — everything a warm restart or a promoting
+        standby needs to continue the control plane without double
+        counting.  One fsynced append per ``_ha_state_every`` window,
+        amortized across every chunk in it (bench: gateway_ha_overhead)."""
+        if not self._serving or self._journal_dead or self._term_fenced:
+            return
+        now = time.monotonic()
+        if now - self._ha_state_last < self._ha_state_every:
+            return
+        self._ha_state_last = now
+        with self._slots_lock:
+            ticks = {str(s): int(q) for s, q in self._tick_seq.items()}
+        self._ha_append("state", {
+            "tick_seq": ticks,
+            "clock": {
+                "learner_step": int(self.clock.learner_step.value),
+                "actor_step": int(self.clock.actor_step.value)},
+            "chunks_in": int(self._ha_carry.get("chunks_in", 0))
+            + self.chunks_in,
+            "lost": self.failover_lost,
+            "ledger": self._ha_ledger()})
+
+    def _seed_records(self, recs: List[Dict[str, Any]]) -> None:
+        """Apply journal/sync records to local control state.  Every
+        field is an ABSOLUTE value applied through max(), so any replay
+        — a restarted standby re-pulling from an old offset, a recovery
+        scan over a file containing duplicates — lands exactly once."""
+        for rec in recs:
+            kind, data = rec.get("kind"), rec.get("data") or {}
+            if kind == "slot":
+                s = int(data.get("slot", -1))
+                inc = int(data.get("inc", -1))
+                if s >= 0:
+                    with self._slots_lock:
+                        if inc > self._inc_floor.get(s, -1):
+                            self._inc_floor[s] = inc
+            elif kind == "state":
+                with self._slots_lock:
+                    for s, q in (data.get("tick_seq") or {}).items():
+                        si = int(s)
+                        if int(q) > self._tick_seq.get(si, -1):
+                            self._tick_seq[si] = int(q)
+                led = data.get("ledger") or {}
+                for k in ("ingested", "shed", "quarantined"):
+                    v = int(led.get(k, 0))
+                    if v > self._ha_carry.get(k, 0):
+                        self._ha_carry[k] = v
+                ci = int(data.get("chunks_in", 0))
+                if ci > self._ha_carry.get("chunks_in", 0):
+                    self._ha_carry["chunks_in"] = ci
+                lost = int(data.get("lost", 0))
+                if lost > self.failover_lost:
+                    self.failover_lost = lost
+
+    def _apply_record(self, rec: Dict[str, Any]) -> None:
+        """Standby side: digest-check one pulled record, persist it to
+        the applied-copy journal (dup seqs are no-ops) and seed state."""
+        try:
+            if rec.get("sha") != _rec_digest(
+                    int(rec["seq"]), rec["kind"], rec["data"]):
+                return
+        except (KeyError, TypeError, ValueError):
+            return
+        if self._journal is not None and not self._journal.apply(rec):
+            return
+        self._seed_records([rec])
+
+    def _ha_emit(self, stale: float) -> None:
+        """The standby's health scalar: ``gateway/sync_stale`` is 1.0
+        while the primary is unreachable and 0.0 when healthy — the
+        telemetry DEFAULT_RULES ``gateway_failover`` alert fires on
+        sustained staleness and RESOLVES once the promoted standby keeps
+        reporting 0.  Non-HA fleets never report the tag, so the rule is
+        inert there (absence rules never fire for never-seen tags)."""
+        if self._ha_writer is None:
+            return
+        try:
+            wall = time.time()
+            self._ha_writer.scalar("gateway/sync_stale", float(stale),
+                                   step=self._sync_seq, wall=wall)
+            self._ha_writer.scalar("gateway/term", float(self.term),
+                                   step=self._sync_seq, wall=wall)
+            self._ha_writer.flush()
+        except Exception:  # noqa: BLE001 - telemetry must not kill HA
+            pass
+
+    def _sync_once(self) -> bool:
+        """One sessionless T_SYNC pull from the primary; returns False
+        on any wire/reply failure (the promotion clock's input)."""
+        timeout = max(0.5, self._gp.sync_s * 4)
+        try:
+            sock = socket.create_connection(self._sync_from,
+                                            timeout=timeout)
+        except OSError:
+            return False
+        try:
+            sock.settimeout(timeout)
+            _send_frame(sock, T_SYNC,
+                        json.dumps({"since": self._sync_seq}).encode())
+            rtype, payload = _recv_frame(sock)
+            if rtype != T_SYNC:
+                return False
+            reply = json.loads(payload.decode())
+        except (ConnectionError, OSError, ValueError):
+            return False
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if reply.get("error"):
+            return False
+        self._sync_term = max(self._sync_term, int(reply.get("term", 0)))
+        for rec in reply.get("records", []):
+            self._apply_record(rec)
+        self._sync_seq = max(self._sync_seq,
+                             int(reply.get("seq", self._sync_seq)))
+        return True
+
+    def _promote(self) -> None:
+        """Fenced promotion: CAS-bump the on-disk term above everything
+        this standby has seen (disk, stream, self), open the new term's
+        WAL continuing the global seq numbering, and start serving.  Any
+        resurrected predecessor now reads a higher term and fences."""
+        disk = self._journal.read_term() if self._journal else 0
+        new_term = max(disk, self._sync_term, self.term) + 1
+        jr = GatewayJournal(self._ha_log_dir)
+        jr.seq = self._journal.seq if self._journal else 0
+        try:
+            jr.write_term(new_term)
+            jr.start_term(new_term)
+        except OSError:
+            # no shared log dir => cannot prove leadership => stay a
+            # (non-serving) standby rather than risk split brain
+            self._journal_dead = True
+            return
+        old, self._journal = self._journal, jr
+        if old is not None:
+            old.close()
+        self.term = new_term
+        self.promotions += 1
+        self._role = "primary"
+        self._serving = True
+        self._ha_append("promote", {"term": new_term})
+        self._ha_note_state()
+        self.promoted.set()
+        self._recorder.record("gateway-promoted", term=new_term)
+        print(f"[dcn] standby promoted to gateway term {new_term}",
+              flush=True)
+
+    def _ha_loop(self) -> None:
+        """Warm-standby loop: pull the journal stream on the sync
+        cadence; once the pull has failed for one lease window, promote.
+        After promotion the loop keeps journaling state and emitting the
+        healthy scalar so the ``gateway_failover`` alert resolves."""
+        gp = self._gp
+        while not self._stop.is_set():
+            if self._serving:
+                self._ha_note_state()
+                self._ha_emit(0.0)
+            elif self._sync_once():
+                self._last_sync_ok = time.monotonic()
+                self._ha_emit(0.0)
+            else:
+                self._ha_emit(1.0)
+                if (time.monotonic() - self._last_sync_ok) > gp.lease_s:
+                    self._promote()
+            self._stop.wait(gp.sync_s)
+
+    def note_failover_lost(self, rows: int) -> None:
+        """Count acked-but-undrained rows that died with the old
+        primary's ingest queue.  Only the wiring that discards that
+        queue knows the number (the drill, or a fleet restart path) —
+        counting it HERE keeps the conservation ledger exact across a
+        failover instead of letting the rows silently vanish."""
+        self.failover_lost += int(rows)
+        self._recorder.record("failover-lost", rows=int(rows))
 
     @property
     def flow(self):
@@ -1611,6 +2249,33 @@ class DcnGateway:
             # + the fencing ledger — fleet_top's ``replicas:`` panel
             # line and the chaos drills' exact-counter verdicts
             snap["replicas"] = self._replicas.status_block()
+        if self._ha:
+            # gateway HA plane (ISSUE 16): role/term/sync lag + the
+            # failover ledger — fleet_top's ``gateway:`` panel line and
+            # the failover drill's exact-counter verdicts.  Absent with
+            # HA off: pre-HA peers observe zero new fields anywhere.
+            snap["gateway"] = {
+                "role": self._role,
+                "term": self.term,
+                "serving": self._serving,
+                "fenced": bool(self._term_fenced or self._journal_dead),
+                "term_fenced": self.gateway_term_fenced,
+                "standby_refused": self.standby_refused,
+                "promotions": self.promotions,
+                "failover_lost": self.failover_lost,
+                "sync_served": self.sync_served,
+                "sync_seq": self._sync_seq,
+                "sync_term": self._sync_term,
+                "sync_age": round(now - self._last_sync_ok, 3),
+                "journal_seq": (self._journal.seq
+                                if self._journal else 0),
+                "journal_appends": (self._journal.appends
+                                    if self._journal else 0),
+                "recover_warnings": (self._journal.recover_warnings
+                                     if self._journal else 0),
+                "carry": {k: int(v)
+                          for k, v in self._ha_carry.items()},
+            }
         if self._health is not None:
             try:
                 snap.update(self._health() or {})
@@ -1648,6 +2313,15 @@ class DcnGateway:
             if ind < self.local_actors:
                 return (f"actor slot {ind} is local to the learner host "
                         f"(local_actors={self.local_actors})")
+            if self._ha and incarnation <= self._inc_floor.get(ind, -1):
+                # journal-seeded fencing (ISSUE 16): a zombie actor
+                # process dialing the PROMOTED gateway with an
+                # incarnation at or below the floor the old primary
+                # journaled is its own fenced predecessor — refusing
+                # here is the slot-fencing contract surviving failover
+                return (f"actor slot {ind} incarnation {incarnation} "
+                        f"fenced by journaled floor "
+                        f"{self._inc_floor[ind]}")
             held = self._slots.get(ind)
             if held is not None:
                 held_inc, held_conn = held
@@ -1660,6 +2334,8 @@ class DcnGateway:
                                       old=held_inc, new=incarnation)
             self._slots[ind] = (incarnation, conn)
             self._last_seen[ind] = time.monotonic()
+            if self._ha and incarnation > self._inc_floor.get(ind, -1):
+                self._inc_floor[ind] = incarnation
         if evict is not None:
             # outside the lock: unblock the predecessor's serve thread;
             # its release is identity-checked so it cannot free OUR claim
@@ -1735,8 +2411,13 @@ class DcnGateway:
             with conn:
                 while not self._stop.is_set():
                     ftype, payload = _recv_frame(conn)
+                    if self._ha:
+                        # HA gate first: an unpromoted standby or a
+                        # fenced stale-term gateway must refuse session
+                        # verbs BEFORE any of their side effects
+                        self._session_gate(ftype)
                     if ftype not in (T_STATUS, T_PROFILE, T_METRICS,
-                                     T_RLEASE, T_RGRAD, T_RPRIO):
+                                     T_RLEASE, T_RGRAD, T_RPRIO, T_SYNC):
                         # STATUS/PROFILE/METRICS probes and the replica
                         # plane are outside the wire fault plane: a
                         # monitor polling the gateway must neither shift
@@ -1856,6 +2537,29 @@ class DcnGateway:
                             reply = self._replicas.handle_prio(payload)
                         _send_frame(conn, T_RPRIO,
                                     json.dumps(reply).encode())
+                    elif ftype == T_SYNC:
+                        # gateway HA control-plane pull (ISSUE 16),
+                        # sessionless like STATUS: the warm standby asks
+                        # for journal records past its applied offset
+                        msg = self._json(payload) if payload else {}
+                        if (not self._ha or self._journal is None
+                                or not self._serving
+                                or self._term_fenced):
+                            reply = {"error":
+                                     "no HA journal serving on this "
+                                     "gateway"}
+                        else:
+                            since = int(msg.get("since", 0))
+                            base, recs = \
+                                self._journal.records_since(since)
+                            reply = {"term": self.term,
+                                     "seq": self._journal.seq,
+                                     "base_seq": base,
+                                     "records": recs,
+                                     "wall": time.time()}
+                        self.sync_served += 1
+                        _send_frame(conn, T_SYNC,
+                                    json.dumps(reply).encode())
                     elif ftype == T_EXP:
                         try:
                             items = decode_chunk(payload)
@@ -1927,6 +2631,8 @@ class DcnGateway:
                                 pass
                         self.chunks_in += 1
                         _send_frame(conn, T_CLOCK, self._clock_payload(slot))
+                        if self._ha:
+                            self._ha_note_state()
                     elif ftype == T_GETP:
                         try:
                             (min_version,) = struct.unpack("!Q", payload)
@@ -1974,6 +2680,8 @@ class DcnGateway:
                             self._flow.on_client_report(
                                 slot, msg.get("flow"))
                         _send_frame(conn, T_CLOCK, self._clock_payload(slot))
+                        if self._ha:
+                            self._ha_note_state()
                     elif ftype == T_HELLO:
                         msg = self._json(payload)
                         try:
@@ -1991,6 +2699,12 @@ class DcnGateway:
                                         json.dumps(reply).encode())
                             return
                         slot = ind
+                        if self._ha and ind is not None:
+                            # journal the claim (absolute incarnation:
+                            # idempotent) so the standby fences stale
+                            # actor incarnations across a failover
+                            self._ha_append("slot",
+                                            {"slot": ind, "inc": inc})
                         _send_frame(conn, T_CLOCK, self._clock_payload(slot))
                     else:
                         raise ConnectionError(f"bad frame type {ftype}")
@@ -2016,6 +2730,10 @@ class DcnGateway:
         # thread leaves its accept() syscall — join it, or an immediate
         # rebind on the same port (restart_gateway) races into EADDRINUSE
         self._accept_thread.join(2.0)
+        if self._ha_thread is not None:
+            self._ha_thread.join(max(2.0, self._gp.sync_s * 4))
+        if self._journal is not None:
+            self._journal.close()
         with self._slots_lock:
             conns = list(self._conns)
         for c in conns:
@@ -2058,29 +2776,64 @@ def feed_queue_of(memory_handles) -> Callable[[list], None]:
 # health-plane client
 # ---------------------------------------------------------------------------
 
+def _sessionless_rpc(address: Tuple[str, int], ftype: int, payload: bytes,
+                     timeout: float, what: str,
+                     retry_after_send: bool = True) -> dict:
+    """Shared core of the sessionless helpers (ISSUE 16 satellite):
+    one bounded round-trip on a fresh connection, with a SINGLE retry
+    so a monitor probing a half-dead gateway mid-failover — one that
+    accepts the connection and never replies — gets a clean
+    ConnectionError after ~2 timeouts instead of wedging forever.  The
+    per-call ``settimeout`` bounds every recv; the retry opens a fresh
+    connection (the promoted standby may be answering by then).
+    ``retry_after_send`` False restricts the retry to connect-phase
+    failures for verbs whose server-side work must not run twice
+    (T_PROFILE holds the one-window profiler lock)."""
+    last: Optional[BaseException] = None
+    for attempt in (0, 1):
+        try:
+            sock = socket.create_connection(address, timeout=timeout)
+        except OSError as e:
+            last = e
+            if attempt == 0:
+                time.sleep(min(0.2, timeout / 10.0))
+            continue
+        sent = False
+        try:
+            sock.settimeout(timeout)
+            _send_frame(sock, ftype, payload)
+            sent = True
+            rtype, reply = _recv_frame(sock)
+            if rtype != ftype:
+                raise ConnectionError(
+                    f"expected {what} reply, got frame type {rtype}")
+            try:
+                return json.loads(reply.decode())
+            except (ValueError, UnicodeDecodeError) as e:
+                raise ConnectionError(f"undecodable {what} reply: {e}")
+        except (ConnectionError, OSError) as e:
+            last = e
+            if attempt == 1 or (sent and not retry_after_send):
+                raise
+            time.sleep(min(0.2, timeout / 10.0))
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+    raise ConnectionError(f"{what} request to {address} failed: {last!r}")
+
+
 def fetch_status(address: Tuple[str, int], timeout: float = 5.0) -> dict:
     """One STATUS round-trip against a gateway — the read side of the
     live health plane (tools/fleet_top.py).  Deliberately sessionless:
     no HELLO, no slot claim, a fresh connection per probe so a monitor
     keeps working across gateway restarts exactly when it matters most.
-    Raises ConnectionError/OSError when the gateway is unreachable."""
-    sock = socket.create_connection(address, timeout=timeout)
-    try:
-        sock.settimeout(timeout)
-        _send_frame(sock, T_STATUS, b"")
-        rtype, payload = _recv_frame(sock)
-        if rtype != T_STATUS:
-            raise ConnectionError(
-                f"expected T_STATUS reply, got frame type {rtype}")
-        try:
-            return json.loads(payload.decode())
-        except (ValueError, UnicodeDecodeError) as e:
-            raise ConnectionError(f"undecodable STATUS reply: {e}")
-    finally:
-        try:
-            sock.close()
-        except OSError:
-            pass
+    Every socket operation is bounded by ``timeout`` and the probe is
+    retried ONCE on a fresh connection (a gateway mid-failover may
+    accept and die before replying).  Raises ConnectionError/OSError
+    when the gateway stays unreachable."""
+    return _sessionless_rpc(address, T_STATUS, b"", timeout, "T_STATUS")
 
 
 def fetch_profile(address: Tuple[str, int], seconds: float = 3.0,
@@ -2101,26 +2854,14 @@ def fetch_profile(address: Tuple[str, int], seconds: float = 3.0,
     reply arriving early, not never."""
     if timeout is None:
         timeout = float(seconds) + 180.0
-    sock = socket.create_connection(address, timeout=timeout)
-    try:
-        sock.settimeout(timeout)
-        msg: Dict[str, Any] = {"seconds": float(seconds), "role": role}
-        if label is not None:
-            msg["label"] = str(label)
-        _send_frame(sock, T_PROFILE, json.dumps(msg).encode())
-        rtype, payload = _recv_frame(sock)
-        if rtype != T_PROFILE:
-            raise ConnectionError(
-                f"expected T_PROFILE reply, got frame type {rtype}")
-        try:
-            return json.loads(payload.decode())
-        except (ValueError, UnicodeDecodeError) as e:
-            raise ConnectionError(f"undecodable PROFILE reply: {e}")
-    finally:
-        try:
-            sock.close()
-        except OSError:
-            pass
+    msg: Dict[str, Any] = {"seconds": float(seconds), "role": role}
+    if label is not None:
+        msg["label"] = str(label)
+    # retry only covers the connect phase: once the request is on the
+    # wire the server may already hold the one-window profiler lock, and
+    # a blind retry would answer "profiler busy" instead of the result
+    return _sessionless_rpc(address, T_PROFILE, json.dumps(msg).encode(),
+                            timeout, "T_PROFILE", retry_after_send=False)
 
 
 def push_metrics(address: Tuple[str, int], rows: list,
@@ -2141,23 +2882,12 @@ def push_metrics(address: Tuple[str, int], rows: list,
         msg["offset"] = float(offset)
     if host is not None:
         msg["host"] = str(host)
-    sock = socket.create_connection(address, timeout=timeout)
-    try:
-        sock.settimeout(timeout)
-        _send_frame(sock, T_METRICS, json.dumps(msg).encode())
-        rtype, payload = _recv_frame(sock)
-        if rtype != T_METRICS:
-            raise ConnectionError(
-                f"expected T_METRICS reply, got frame type {rtype}")
-        try:
-            return json.loads(payload.decode())
-        except (ValueError, UnicodeDecodeError) as e:
-            raise ConnectionError(f"undecodable METRICS reply: {e}")
-    finally:
-        try:
-            sock.close()
-        except OSError:
-            pass
+    # full single-retry: re-pushing the same rows is at worst a
+    # duplicate scalar sample on the same wall clock, and the pusher's
+    # own catch-up window already tolerates that; wedging the stats
+    # thread on a half-dead gateway is the failure that matters
+    return _sessionless_rpc(address, T_METRICS, json.dumps(msg).encode(),
+                            timeout, "T_METRICS")
 
 
 # ---------------------------------------------------------------------------
@@ -2224,7 +2954,17 @@ class DcnClient:
                  reply_deadline: Optional[float] = None,
                  reconnect_timeout: Optional[float] = None,
                  faults: Optional[FaultInjector] = None):
-        self.address = address
+        # ordered endpoint list (ISSUE 16): a single ``(host, port)`` is
+        # the pre-HA contract, byte-identical behaviour; a list (or a
+        # "h:p,h:p" string) dials in order, and the redial path cycles
+        # to the NEXT endpoint on failure — failover to the promoted
+        # standby rides the exact PR-1 re-HELLO/incarnation/
+        # unacked-resend machinery, and the PR-11 cumulative flow
+        # counters make the resend idempotent across gateways.
+        self.endpoints = parse_endpoints(address) or [address]
+        self._ep = 0
+        self.failovers = 0
+        self.address = self.endpoints[0]
         self.process_ind = process_ind
         self._lock = threading.RLock()
         self.learner_step = 0
@@ -2288,12 +3028,17 @@ class DcnClient:
         delay = 0.1
         while True:
             try:
-                self._sock = socket.create_connection(address, timeout=30.0)
+                self.address = self.endpoints[self._ep]
+                self._sock = socket.create_connection(self.address,
+                                                      timeout=30.0)
                 break
             except OSError:
                 if time.monotonic() > deadline or retries <= 0:
                     raise
                 retries -= 1
+                # cycle the endpoint list: the next dial may be the
+                # standby already serving (no-op with one endpoint)
+                self._ep = (self._ep + 1) % len(self.endpoints)
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
         self._configure(self._sock)
@@ -2399,10 +3144,15 @@ class DcnClient:
                 raise self._terminal(
                     f"reconnect budget ({self._reconnect_timeout:.1f}s) "
                     f"exhausted")
+            addr = self.endpoints[self._ep]
             try:
                 sock = socket.create_connection(
-                    self.address, timeout=max(0.1, min(5.0, remaining)))
+                    addr, timeout=max(0.1, min(5.0, remaining)))
             except OSError:
+                # failover (ISSUE 16): cycle to the next endpoint — a
+                # dead primary's slot in the list is skipped within one
+                # backoff step (no-op with a single endpoint)
+                self._ep = (self._ep + 1) % len(self.endpoints)
                 time.sleep(min(delay, max(0.0, remaining)))
                 delay = redial_backoff(self._redial_rng, delay)
                 continue
@@ -2424,12 +3174,23 @@ class DcnClient:
                     sock.close()
                 except OSError:
                     pass
+                # an accepted-then-dropped HELLO is what an unpromoted
+                # standby answers with — keep cycling until it promotes
+                # (or the budget spends)
+                self._ep = (self._ep + 1) % len(self.endpoints)
                 time.sleep(min(delay, max(0.0, remaining)))
                 delay = redial_backoff(self._redial_rng, delay)
                 continue
             self._configure(sock)  # restore the steady-state reply deadline
             self._sock = sock
             self.reconnects += 1
+            if addr != self.address:
+                # the session moved gateways: the counted failover event
+                self.failovers += 1
+                self._recorder.record("failover", slot=self.process_ind,
+                                      frm=list(self.address),
+                                      to=list(addr))
+                self.address = addr
             self._recorder.record("reconnect", slot=self.process_ind,
                                   incarnation=self.incarnation,
                                   count=self.reconnects)

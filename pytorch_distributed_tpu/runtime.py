@@ -158,13 +158,22 @@ class Topology:
         # Topology with replicas > 1 has no registry and the learner
         # downgrades loudly to solo (agents/learner.py delegation gate).
         from pytorch_distributed_tpu.parallel.dcn import (
-            export_replica_env, resolve_replica,
+            export_gateway_env, export_replica_env, resolve_gateway,
+            resolve_replica,
         )
 
         self.replica = resolve_replica(opt.replica_params)
         if self.replica.replicas > 1:
             export_replica_env(self.replica)
         self.replica_registry = None
+        # gateway HA plane (ISSUE 16): same resolve-once + export
+        # contract — spawn children (remote actor mains, the standby
+        # runner) must dial the same endpoint list and lease windows
+        # the topology was configured with.  Off by default: a plain
+        # fleet never journals, never syncs, stays byte-compatible.
+        self.gateway_ha = resolve_gateway(opt.gateway_params)
+        if self.gateway_ha.enabled:
+            export_gateway_env(self.gateway_ha)
         # ---- mission control (ISSUE 10): fleet metrics aggregation +
         # SLO/alert engine + opt-in OpenMetrics endpoint.  Built here
         # (unstarted) so the fleet gateway's T_METRICS sink has a

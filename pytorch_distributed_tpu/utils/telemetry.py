@@ -449,6 +449,15 @@ DEFAULT_RULES = (
     # crash-looping through lease/rejoin cycles — each individual cycle
     # "recovers", so only the rate exposes the loop
     "replica_churn: replica/generation_churn > 3 for 120s",
+    # gateway HA plane (ISSUE 16): the warm standby reports
+    # ``gateway/sync_stale`` on its sync cadence — 0 while the primary
+    # answers T_SYNC, 1 while it doesn't.  Sustained staleness means
+    # the primary is gone and a failover is in progress; the rule
+    # RESOLVES once the promoted standby keeps reporting 0 as the new
+    # primary.  Non-HA fleets never report the tag, so the rule stays
+    # silently inert there (threshold rules never fire on a series
+    # that was never written)
+    "gateway_failover: gateway/sync_stale >= 1 for 60s",
 )
 
 

@@ -34,9 +34,12 @@ def main() -> None:
     opt = build_options(
         1, root_dir=args.root_dir, refs=args.refs, seed=args.seed,
         hidden_dim=32, batch_size=8, memory_size=128, learn_start=32,
-        steps=args.steps, replicas=2, lease_s=1.5,
+        steps=args.steps, replicas=2,
         join_timeout_s=120.0, evaluator_nepisodes=0,
     )
+    # lease_s lives on both the replica and gateway planes (ISSUE 16),
+    # so the bare build_options override is ambiguous — set it directly
+    opt.replica_params.lease_s = 1.5
     run_replica_host(opt, args.coordinator, args.replica_id)
 
 

@@ -1,0 +1,342 @@
+"""Gateway high-availability drills (ISSUE 16, parallel/dcn.py
+GatewayJournal + T_SYNC + DcnClient endpoint lists): the durable
+control plane's WAL edges (torn tail, corrupt clean slate, idempotent
+resync), the fast failover drill (promotion within one lease window,
+fenced resurrection, client endpoint failover), the no-standby seed
+contract (EXIT_DISCONNECTED unchanged), the byte-compat contract (HA
+off => nothing new observable), and the sessionless helpers' bounded
+timeouts.  All numpy-only and seconds-scale; the randomized long-haul
+version is ``tools/chaos_soak.py --kill-gateway``."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.agents.clocks import ActorStats, GlobalClock
+from pytorch_distributed_tpu.agents.param_store import ParamStore
+from pytorch_distributed_tpu.config import GatewayParams
+from pytorch_distributed_tpu.parallel.dcn import (
+    T_HELLO, DcnClient, DcnDisconnected, DcnGateway, GatewayJournal,
+    _recv_frame, _rec_digest, _send_frame, fetch_status, parse_endpoints,
+)
+from tools.chaos_soak import ChunkLog, tagged_transition
+
+GP = GatewayParams(enabled=True, lease_s=0.4, sync_s=0.05)
+
+
+def make_gateway(tmp, log, role="primary", sync_from=None,
+                 resume_term=None, gp=GP):
+    clock = GlobalClock()
+    store = ParamStore(8)
+    store.publish(np.zeros(8, dtype=np.float32))
+    return DcnGateway(store, clock, ActorStats(), put_chunk=log,
+                      host="127.0.0.1", port=0, idle_deadline=30.0,
+                      gateway_params=gp, log_dir=str(tmp),
+                      ha_role=role, sync_from=sync_from,
+                      resume_term=resume_term)
+
+
+# ---------------------------------------------------------------------------
+# WAL recovery edges
+# ---------------------------------------------------------------------------
+
+def test_wal_roundtrip_and_torn_tail(tmp_path):
+    """A torn trailing record — the fsync victim a crash leaves — is
+    skipped with a counted warning; everything before it recovers."""
+    j = GatewayJournal(str(tmp_path))
+    j.write_term(3)
+    j.start_term(3)
+    for i in range(5):
+        j.append("slot", {"slot": i, "inc": 100 + i})
+    path = j._wal_path(3)
+    j.close()
+    with open(path, "ab") as f:  # torn write: half a record, no newline
+        f.write(b'{"seq": 99, "kind": "slot", "da')
+    j2 = GatewayJournal(str(tmp_path))
+    term, recs = j2.recover()
+    assert term == 3
+    assert [r["data"]["slot"] for r in recs] == [0, 1, 2, 3, 4]
+    assert j2.recover_warnings >= 1
+    assert j2.read_term() == 3
+    j2.close()
+
+
+def test_wal_corrupt_is_counted_clean_slate(tmp_path):
+    """Garbage where the journal should be: recovery is a COUNTED clean
+    slate (warn, continue at term 0), never a crash."""
+    gwdir = tmp_path / "gateway"
+    os.makedirs(gwdir)
+    (gwdir / "TERM.json").write_text("{not json")
+    (gwdir / "wal-00000007.jsonl").write_text("complete garbage\n\x00\x01")
+    j = GatewayJournal(str(tmp_path))
+    assert j.read_term() == 0
+    term, recs = j.recover()
+    assert term == 7  # the term FLOOR survives even an unreadable file
+    assert recs == []
+    assert j.recover_warnings >= 1
+    j.close()
+
+
+def test_wal_record_digest_rejects_tamper(tmp_path):
+    """A bit-flipped record fails its digest and is dropped, counted."""
+    j = GatewayJournal(str(tmp_path))
+    j.write_term(1)
+    j.start_term(1)
+    j.append("slot", {"slot": 0, "inc": 5})
+    j.append("slot", {"slot": 1, "inc": 6})
+    path = j._wal_path(1)
+    j.close()
+    lines = open(path).read().splitlines()
+    lines[0] = lines[0].replace('"inc": 5', '"inc": 500')
+    open(path, "w").write("\n".join(lines) + "\n")
+    j2 = GatewayJournal(str(tmp_path))
+    _term, recs = j2.recover()
+    assert [r["data"]["slot"] for r in recs] == [1]
+    assert j2.recover_warnings >= 1
+    j2.close()
+
+
+def test_standby_applied_copy_is_idempotent(tmp_path):
+    """The standby's applied-copy journal dedups by seq — a restart
+    that re-pulls an overlapping suffix lands every record once."""
+    j = GatewayJournal(str(tmp_path), standby=True)
+    recs = [{"seq": i, "kind": "slot",
+             "data": {"slot": 0, "inc": i},
+             "sha": _rec_digest(i, "slot", {"slot": 0, "inc": i})}
+            for i in range(1, 6)]
+    for r in recs:
+        assert j.apply(r) is True
+    # the restart: a fresh standby journal recovers its own offset...
+    j.close()
+    j2 = GatewayJournal(str(tmp_path), standby=True)
+    _t, seen = j2.recover()
+    assert len(seen) == 5 and j2.seq == 5
+    # ...and re-applying an overlapping suffix is a counted no-op
+    assert all(j2.apply(r) is False for r in recs[2:])
+    assert j2.seq == 5
+    j2.close()
+
+
+def test_seed_records_double_apply_no_double_count(tmp_path):
+    """State records carry ABSOLUTE values applied through max(): a
+    primary warm-restarting over a journal it already absorbed (or a
+    standby re-pulling a suffix) never double-counts the ledger."""
+    log = ChunkLog()
+    gw = make_gateway(tmp_path, log)
+    try:
+        recs = [{"seq": 1, "kind": "state",
+                 "data": {"tick_seq": {"0": 7}, "chunks_in": 40,
+                          "lost": 3,
+                          "ledger": {"ingested": 100, "shed": 2,
+                                     "quarantined": 1}}},
+                {"seq": 2, "kind": "slot", "data": {"slot": 0, "inc": 9}}]
+        gw._seed_records(recs)
+        first = dict(gw._ha_carry)
+        lost = gw.failover_lost
+        gw._seed_records(recs)  # the replay: must be a no-op
+        assert gw._ha_carry == first
+        assert gw.failover_lost == lost == 3
+        assert gw._ha_carry["ingested"] == 100
+        assert gw._inc_floor[0] == 9
+    finally:
+        gw.close()
+
+
+def test_warm_restart_continues_term_and_ledger(tmp_path):
+    """A primary restarted over its own journal bumps the term and
+    carries the cumulative ledger forward instead of forgetting it."""
+    log = ChunkLog()
+    gw = make_gateway(tmp_path, log)
+    t1 = gw.term
+    gw._ha_append("state", {"tick_seq": {}, "chunks_in": 11, "lost": 0,
+                            "ledger": {"ingested": 22, "shed": 0,
+                                       "quarantined": 0}})
+    gw.close()
+    gw2 = make_gateway(tmp_path, log)
+    try:
+        assert gw2.term == t1 + 1
+        snap = gw2.status_snapshot()["gateway"]
+        assert snap["carry"]["chunks_in"] == 11
+        assert snap["carry"]["ingested"] == 22
+    finally:
+        gw2.close()
+
+
+# ---------------------------------------------------------------------------
+# failover fast drill: promotion, client failover, fenced resurrection
+# ---------------------------------------------------------------------------
+
+def _hello(addr, slot=7, inc=None):
+    """Raw HELLO: returns the reply dict, or None if the gateway
+    dropped the connection (the standby/fenced refusal path)."""
+    sock = socket.create_connection(addr, timeout=2.0)
+    try:
+        sock.settimeout(2.0)
+        _send_frame(sock, T_HELLO, json.dumps(
+            {"process_ind": slot,
+             "incarnation": inc or time.time_ns()}).encode())
+        try:
+            _ftype, payload = _recv_frame(sock)
+        except (ConnectionError, OSError):
+            return None
+        return json.loads(payload.decode())
+    finally:
+        sock.close()
+
+
+def test_failover_promotion_fencing_and_resurrection(tmp_path):
+    log = ChunkLog()
+    primary = make_gateway(tmp_path, log)
+    old_term = primary.term
+    standby = make_gateway(tmp_path, log, role="standby",
+                           sync_from=("127.0.0.1", primary.port))
+    endpoints = [("127.0.0.1", primary.port),
+                 ("127.0.0.1", standby.port)]
+    client = DcnClient(endpoints, process_ind=0,
+                       reconnect_timeout=10.0, heartbeat_interval=0.2)
+    try:
+        # pre-kill: sessions land on the primary; the standby REFUSES
+        assert _hello(("127.0.0.1", standby.port)) is None
+        assert standby.standby_refused >= 1
+        for i in range(5):
+            client.send_chunk([(tagged_transition(i), None)])
+        deadline = time.monotonic() + 3.0  # journal the claims/state
+        while primary.status_snapshot()["gateway"]["journal_seq"] < 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        primary.close()
+        assert standby.promoted.wait(GP.lease_s * 4 + 2.0), \
+            "standby never promoted"
+        # the client fails over along its endpoint list and lives on
+        for i in range(5, 10):
+            client.send_chunk([(tagged_transition(i), None)])
+        deadline = time.monotonic() + 8.0
+        while (not {int(t) for t in log.tags}.issuperset(range(10))
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert client.failovers == 1
+        assert client.address == ("127.0.0.1", standby.port)
+        snap = standby.status_snapshot()["gateway"]
+        assert snap["role"] == "primary"
+        assert snap["term"] == old_term + 1
+        assert snap["promotions"] == 1
+        # the journaled incarnation floor crossed the failover: a
+        # STALE incarnation for the claimed slot is refused on rejoin
+        reply = _hello(("127.0.0.1", standby.port), slot=0, inc=1)
+        assert reply is None or reply.get("error"), \
+            f"stale incarnation re-claimed the slot: {reply}"
+        # resurrection: the old primary comes back on its STALE term —
+        # every session is refused, counted, and nothing is applied
+        zsink = ChunkLog()
+        zombie = make_gateway(tmp_path, zsink, resume_term=old_term)
+        try:
+            assert _hello(("127.0.0.1", zombie.port)) is None
+            assert zombie.gateway_term_fenced >= 1
+            assert zombie.chunks_in == 0 and zsink.tags == []
+        finally:
+            zombie.close()
+        delivered = {int(t) for t in log.tags}
+        assert delivered.issuperset(range(10)), \
+            f"rows lost across failover: {sorted(delivered)}"
+    finally:
+        client.close()
+        standby.close()
+
+
+def test_no_standby_leg_exits_disconnected(tmp_path):
+    """Without a standby the seed contract is untouched: a dead
+    gateway still ends in DcnDisconnected after the redial budget."""
+    log = ChunkLog()
+    gw = make_gateway(tmp_path, log)
+    client = DcnClient(("127.0.0.1", gw.port), process_ind=0,
+                       reconnect_timeout=1.0, heartbeat_interval=0.2)
+    try:
+        client.send_chunk([(tagged_transition(0), None)])
+        gw.close()
+        with pytest.raises(DcnDisconnected):
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                client.send_chunk([(tagged_transition(1), None)])
+                time.sleep(0.05)
+        assert client.disconnected.is_set()
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# byte-compat: HA off => nothing new observable
+# ---------------------------------------------------------------------------
+
+def test_ha_disabled_is_byte_compatible(tmp_path):
+    """With the plane off (the default) there is no STATUS block, no
+    journal dir, and a single-endpoint client behaves as the seed."""
+    clock = GlobalClock()
+    store = ParamStore(8)
+    store.publish(np.zeros(8, dtype=np.float32))
+    gw = DcnGateway(store, clock, ActorStats(),
+                    put_chunk=lambda items: None,
+                    host="127.0.0.1", port=0,
+                    log_dir=str(tmp_path))  # log_dir alone must not arm it
+    client = DcnClient(("127.0.0.1", gw.port), process_ind=0)
+    try:
+        status = fetch_status(("127.0.0.1", gw.port))
+        assert "gateway" not in status
+        assert not os.path.exists(tmp_path / "gateway")
+        assert client.endpoints == [("127.0.0.1", gw.port)]
+        assert client.failovers == 0
+        client.send_chunk([(tagged_transition(0), None)])
+    finally:
+        client.close()
+        gw.close()
+
+
+def test_parse_endpoints_forms():
+    assert parse_endpoints(("h", 1)) == [("h", 1)]
+    assert parse_endpoints([("a", 1), ("b", 2)]) == [("a", 1), ("b", 2)]
+    assert parse_endpoints("a:1,b:2") == [("a", 1), ("b", 2)]
+    assert parse_endpoints(["a:1", ("b", 2)]) == [("a", 1), ("b", 2)]
+    assert parse_endpoints("") == []
+
+
+# ---------------------------------------------------------------------------
+# sessionless helpers: bounded timeouts, single retry
+# ---------------------------------------------------------------------------
+
+def test_fetch_status_times_out_on_half_dead_gateway():
+    """A listener that accepts and then says nothing — the half-dead
+    gateway a monitor must NOT hang on: two bounded attempts, then a
+    raised error, all within ~4x the per-call timeout."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    eaten = []
+
+    def _eat():
+        try:
+            while True:
+                conn, _ = srv.accept()
+                eaten.append(conn)  # accept, never reply
+        except OSError:
+            pass
+
+    t = threading.Thread(target=_eat, daemon=True)
+    t.start()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises((ConnectionError, OSError)):
+            fetch_status(srv.getsockname(), timeout=0.4)
+        took = time.monotonic() - t0
+        assert took < 4 * 0.4 + 1.0, \
+            f"fetch_status hung {took:.1f}s on a silent gateway"
+        time.sleep(0.3)  # let the accept loop catch up with the backlog
+        assert len(eaten) == 2, \
+            f"expected exactly one retry, saw {len(eaten)} attempts"
+    finally:
+        srv.close()
+        for c in eaten:
+            c.close()

@@ -486,11 +486,15 @@ class TestReplicaChaosDrills:
 # ---------------------------------------------------------------------------
 
 def _oracle_opt(tmp_path, refs="replicas-oracle"):
-    return build_options(
+    opt = build_options(
         1, root_dir=str(tmp_path), refs=refs, seed=11,
         hidden_dim=32, batch_size=8, memory_size=128, learn_start=32,
-        steps=10_000, replicas=2, lease_s=0.6,
+        steps=10_000, replicas=2,
         evaluator_nepisodes=0)
+    # lease_s lives on both the replica and gateway planes (ISSUE 16),
+    # so the bare build_options override is ambiguous — set it directly
+    opt.replica_params.lease_s = 0.6
+    return opt
 
 
 class TestDegradedParityOracle:

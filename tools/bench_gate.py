@@ -75,6 +75,8 @@ SPECS: List[Tuple[str, str, str]] = [
     ("metrics_overhead.metrics_overhead_frac", "lower_abs", "overhead"),
     ("flow_overhead.flow_overhead_frac", "lower_abs", "overhead"),
     ("replica_overhead.replica_overhead_frac", "lower_abs", "overhead"),
+    ("gateway_ha_overhead.gateway_ha_overhead_frac", "lower_abs",
+     "overhead"),
     ("device_env.host_frames_per_sec", "higher", "device_env"),
     ("device_env.device_frames_per_sec", "higher", "device_env"),
     ("device_env.fused_frames_per_sec", "higher", "device_env"),

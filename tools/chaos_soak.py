@@ -75,6 +75,20 @@ unresolved-after-rejoin on the ``replica_degraded`` membership rule,
 and any lease/round/fence counter off its script-predicted value
 (EXACT-ledger verdict).  See ``replica_soak``.
 
+Gateway HA drills (ISSUE 16, the warm-standby failover plane —
+parallel/dcn.py GatewayJournal + T_SYNC): ``--kill-gateway AT`` kills
+the primary mid-run with an undrained backlog behind it; the standby
+must promote within one lease window through the fenced on-disk term
+bump, clients must fail over along their endpoint lists, and the
+ledger must stay EXACT (never-delivered acked rows counted in
+``failover_lost``, nothing uncounted).  ``--resurrect-primary`` brings
+the old primary back on its STALE term — every write must be a counted
+reject (``gateway_term_fenced``), none applied.  ``--no-standby``
+proves the seed contract unchanged: clients end disconnected exactly
+as EXIT_DISCONNECTED always demanded.  A standby that never promotes
+is an explicit readable "gateway never recovered" violation and a
+nonzero exit — never a hang.  See ``gateway_soak``.
+
 Usage:
     python tools/chaos_soak.py --seconds 30 --actors 4 --seed 0
     python tools/chaos_soak.py --seconds 60 --restart-every 5
@@ -85,6 +99,10 @@ Usage:
     python tools/chaos_soak.py --seconds 12 --slow-slot
     python tools/chaos_soak.py --kill-replica 8 --rejoin
     python tools/chaos_soak.py --hang-replica 10 --rejoin
+    python tools/chaos_soak.py --seconds 6 --kill-gateway 1.5
+    python tools/chaos_soak.py --seconds 6 --kill-gateway 1.5 \
+        --resurrect-primary
+    python tools/chaos_soak.py --seconds 6 --kill-gateway 1.5 --no-standby
 
 The same ``SyntheticActor`` drives the deterministic chaos scenarios in
 tests/test_chaos.py; this entry point is the long-haul randomized
@@ -215,6 +233,24 @@ class IngestSim:
         for items in backlog:
             self._sink(items)
             self.drained_chunks += 1
+
+    def spill(self) -> List[int]:
+        """Failover (ISSUE 16): the primary died with this backlog
+        undrained.  Stop the drain, DISCARD the backlog, and return the
+        chunk tags it held — the caller hands the count to
+        ``note_failover_lost`` (the counted ledger bucket) and the
+        verdict checks every never-delivered acked tag is in this set:
+        loss across a failover is legal only where it is counted."""
+        self._stop.set()
+        self._thread.join(2.0)
+        with self._lock:
+            backlog, self._backlog = self._backlog, []
+        tags: List[int] = []
+        for items in backlog:
+            for t, _p in items:
+                if np.isfinite(t.reward):
+                    tags.append(int(t.reward))
+        return tags
 
 
 class SyntheticActor:
@@ -1211,6 +1247,364 @@ def replica_soak(replicas: int = 2, rounds: int = 30, seed: int = 0,
     return report
 
 
+# ---------------------------------------------------------------------------
+# gateway high-availability drills (ISSUE 16): kill the primary under a
+# live fleet — warm standby must promote (fenced), clients must fail
+# over, and the ledger must stay EXACT across the cutover
+# ---------------------------------------------------------------------------
+
+# the gateway drill's rule set: the failover rule MUST fire during the
+# outage and resolve once the promoted standby reports healthy; the
+# flap rule (same tag, 30s dwell no drill can sustain) is the
+# quiet-by-construction guard for the unexpected-alert invariant
+GATEWAY_ALERT_RULES = (
+    "gateway_failover: gateway/sync_stale >= 1 for 0.3s; "
+    "gateway_flap: gateway/sync_stale >= 1 for 30s")
+
+
+def _hello_probe(addr, slot: int = 99) -> bool:
+    """One raw HELLO at ``addr``: True if the gateway ANSWERED (granted
+    a session), False if it dropped the connection — the fenced /
+    unpromoted-standby refusal path.  Raw on purpose: a DcnClient would
+    redial and retry; the zombie verdict needs the single-frame answer."""
+    import socket as socket_mod
+
+    from pytorch_distributed_tpu.parallel.dcn import (
+        T_HELLO, _recv_frame, _send_frame,
+    )
+    import json
+
+    try:
+        sock = socket_mod.create_connection(addr, timeout=2.0)
+    except OSError:
+        return False
+    try:
+        sock.settimeout(2.0)
+        _send_frame(sock, T_HELLO, json.dumps(
+            {"process_ind": slot,
+             "incarnation": time.time_ns()}).encode())
+        _recv_frame(sock)
+        return True
+    except (ConnectionError, OSError):
+        return False
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def gateway_soak(seconds: float = 8.0, actors: int = 3, seed: int = 0,
+                 kill_at: float = 2.5, no_standby: bool = False,
+                 resurrect: bool = False, lease_s: float = 0.8,
+                 sync_s: float = 0.1, poison_every: int = 40,
+                 log_dir: Optional[str] = None, port: int = 0,
+                 verbose: bool = True) -> dict:
+    """The ISSUE-16 gateway HA drill: a primary gateway (journaling its
+    control plane to the shared ``{log_dir}/gateway/`` WAL) serves N
+    synthetic actors while a warm standby tails it over T_SYNC; at
+    ``kill_at`` seconds the primary dies WITH an undrained ingest
+    backlog.  Verdict failures:
+
+    - **gateway never recovered** — the standby fails to promote within
+      the lease window (+ sync slack): reported as an explicit readable
+      violation and a NONZERO exit, never a hang;
+    - **client stranded** — any actor ends other than "stopped" even
+      though a promoted standby was reachable on its endpoint list;
+    - **conservation breached** — an acked chunk that is neither in the
+      delivery log nor in the counted ``failover_lost`` spill set (loss
+      across a failover is legal only where it is counted: minted =
+      delivered + quarantined + failover_lost EXACTLY);
+    - **stale-term write applied** (``resurrect``) — the resurrected
+      old primary answers a session verb or lands a chunk instead of
+      fencing on the promoted term (its refusals must be counted in
+      ``gateway_term_fenced`` with ZERO applied writes);
+    - **alert contract broken** — ``gateway_failover`` must fire during
+      the outage, resolve after promotion, and nothing else may fire.
+
+    With ``no_standby`` the drill proves the SEED contract unchanged:
+    every client must end "disconnected" (the EXIT_DISCONNECTED path)
+    exactly as before the HA plane existed."""
+    import shutil
+    import tempfile
+
+    from pytorch_distributed_tpu.config import (
+        AlertParams, GatewayParams, MetricsParams,
+    )
+    from pytorch_distributed_tpu.utils import flight_recorder, telemetry
+
+    violations: List[str] = []
+    tmp_dir = None
+    ha_dir = log_dir
+    if ha_dir is None:
+        # the WAL needs a dir either way; TERM.json on SHARED storage
+        # is the fencing substrate (same requirement checkpoint resume
+        # already has) — in-process drills share a tempdir
+        ha_dir = tmp_dir = tempfile.mkdtemp(prefix="chaos-gw-")
+    gp = GatewayParams(enabled=True, lease_s=lease_s, sync_s=sync_s)
+
+    if log_dir:
+        flight_recorder.configure(log_dir, run_id="chaos-soak")
+    mission = telemetry.MissionControl(
+        log_dir, MetricsParams(enabled=True, poll_s=0.1),
+        AlertParams(rules=GATEWAY_ALERT_RULES))
+    mission.start()
+    ha_writer = _AggregatorWriter(mission.metrics)
+
+    clock = GlobalClock()
+    stats = ActorStats()
+    store = ParamStore(8)
+    store.publish(np.zeros(8, dtype=np.float32))
+    log = ChunkLog()
+    # the primary drains through a paced ingest so the kill strands a
+    # real backlog — the failover_lost bucket under test; the standby
+    # delivers straight to the log (its ingest isn't the drill's story)
+    ingest = IngestSim(log, bound=256, rate=150.0)
+
+    primary = DcnGateway(store, clock, stats, put_chunk=ingest,
+                         host="127.0.0.1", port=port, idle_deadline=30.0,
+                         gateway_params=gp, log_dir=ha_dir,
+                         ha_role="primary", ha_writer=ha_writer)
+    old_term = primary.term
+    standby = None
+    if not no_standby:
+        standby = DcnGateway(
+            store, clock, ActorStats(), put_chunk=log,
+            host="127.0.0.1", port=0, idle_deadline=30.0,
+            gateway_params=gp, log_dir=ha_dir, ha_role="standby",
+            sync_from=("127.0.0.1", primary.port), ha_writer=ha_writer)
+    endpoints = [("127.0.0.1", primary.port)]
+    if standby is not None:
+        endpoints.append(("127.0.0.1", standby.port))
+
+    fleet = [
+        SyntheticActor(
+            endpoints, slot=i, pace=0.004,
+            poison_every=poison_every,
+            client_kwargs=dict(
+                # without a standby the drill PROVES the seed contract:
+                # clients must give up (EXIT_DISCONNECTED) on the seed
+                # timescale, so keep the redial budget drill-sized
+                reconnect_timeout=(2.0 if no_standby
+                                   else lease_s * 4 + 8.0),
+                heartbeat_interval=0.3,
+            )).start()
+        for i in range(actors)
+    ]
+
+    t_start = time.monotonic()
+    deadline = t_start + seconds
+    killed = False
+    t_kill = 0.0
+    promoted_in: Optional[float] = None
+    spilled: List[int] = []
+    learner_step = 0
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        learner_step += 2
+        clock.set_learner_step(learner_step)
+        if learner_step % 50 == 0:
+            store.publish(np.full(8, learner_step, dtype=np.float32))
+        if not killed and time.monotonic() - t_start >= kill_at:
+            # the kill: the primary stops answering with a live backlog
+            # still queued behind it — those acked-but-undrained rows
+            # are the counted failover_lost bucket
+            primary.close()
+            spilled = ingest.spill()
+            if standby is not None:
+                standby.note_failover_lost(len(spilled))
+            killed = True
+            t_kill = time.monotonic()
+        if killed and standby is not None and promoted_in is None \
+                and standby.promoted.is_set():
+            promoted_in = time.monotonic() - t_kill
+
+    # ---- promotion verdict: bounded, readable, NEVER a hang ---------------
+    promote_bound = lease_s + max(2.0, sync_s * 10 + 1.0)
+    if killed and standby is not None and promoted_in is None:
+        if standby.promoted.wait(max(0.1, promote_bound
+                                     - (time.monotonic() - t_kill))):
+            promoted_in = time.monotonic() - t_kill
+        else:
+            violations.append(
+                f"gateway never recovered: standby failed to promote "
+                f"within {promote_bound:.1f}s of the primary kill "
+                f"(lease {lease_s:g}s) — exiting nonzero instead of "
+                f"hanging the fleet")
+    if promoted_in is not None and promoted_in > promote_bound:
+        violations.append(
+            f"promotion took {promoted_in:.2f}s (> one lease window "
+            f"{lease_s:g}s + sync slack)")
+
+    # ---- resurrection leg: the old primary comes back believing its
+    # stale term — every write must fence, none may apply
+    zombie_report: dict = {}
+    if resurrect and standby is not None and killed:
+        zsink = ChunkLog()
+        zombie = DcnGateway(store, clock, ActorStats(), put_chunk=zsink,
+                            host="127.0.0.1", port=0, idle_deadline=30.0,
+                            gateway_params=gp, log_dir=ha_dir,
+                            ha_role="primary", resume_term=old_term)
+        answered = _hello_probe(("127.0.0.1", zombie.port))
+        zombie_report = {
+            "answered_session": bool(answered),
+            "term_fenced": zombie.gateway_term_fenced,
+            "chunks_applied": zombie.chunks_in + len(zsink.tags),
+        }
+        if answered:
+            violations.append(
+                "resurrected primary granted a session on its stale "
+                "term (unfenced split brain)")
+        if zombie.gateway_term_fenced < 1:
+            violations.append(
+                "resurrected primary's stale-term writes were not "
+                "counted rejects (gateway_term_fenced = 0)")
+        if zombie.chunks_in or zsink.tags:
+            violations.append(
+                f"resurrected stale-term gateway APPLIED "
+                f"{zombie.chunks_in + len(zsink.tags)} writes")
+        zombie.close()
+
+    clock.stop.set()
+    join_budget = (5.0 if no_standby else lease_s * 4 + 20.0)
+    for a in fleet:
+        a.thread.join(join_budget)
+        if a.thread.is_alive():
+            violations.append(f"deadlock: actor {a.slot} still running "
+                              f"at the join deadline")
+        elif no_standby and killed:
+            if a.outcome != "disconnected":
+                violations.append(
+                    f"actor {a.slot} ended {a.outcome!r} (expected "
+                    f"'disconnected' — the seed EXIT_DISCONNECTED "
+                    f"contract must be unchanged without a standby)")
+        elif a.outcome != "stopped":
+            violations.append(f"actor {a.slot} ended {a.outcome!r} "
+                              f"(stranded despite a live standby)")
+
+    if not killed:
+        ingest.close()
+    gb: dict = {}
+    if standby is not None:
+        gb = standby.status_snapshot().get("gateway", {})
+        standby.close()
+    if not killed:
+        primary.close()
+
+    # ---- ledger verdict: EXACT conservation across the failover -----------
+    quarantined = (sum(primary.quarantined.values())
+                   + (sum(standby.quarantined.values())
+                      if standby is not None else 0))
+    seen = log.seen()
+    acked = [t for a in fleet for t in a.acked_tags]
+    spill_set = set(spilled)
+    lost = [t for t in acked if t not in seen]
+    uncounted = [t for t in lost if t not in spill_set]
+    if uncounted:
+        violations.append(
+            f"conservation breached: {len(uncounted)} acked rows "
+            f"vanished outside the counted failover_lost spill "
+            f"(first: {uncounted[:5]})")
+    if standby is not None and killed \
+            and gb.get("failover_lost") != len(spilled):
+        violations.append(
+            f"ledger mismatch: failover_lost = "
+            f"{gb.get('failover_lost')} (expected {len(spilled)} "
+            f"spilled rows)")
+    poisoned_sent = sum(a.poisoned_sent for a in fleet)
+    if log.poisoned_delivered:
+        violations.append(
+            f"{log.poisoned_delivered} poisoned transitions reached "
+            f"put_chunk (quarantine breached across failover)")
+    if poisoned_sent and not quarantined:
+        violations.append(
+            f"{poisoned_sent} poisoned chunks sent but neither "
+            f"gateway quarantined any")
+    failovers = sum(a.client.failovers for a in fleet if a.client)
+    if standby is not None and killed and promoted_in is not None:
+        if failovers < 1:
+            violations.append(
+                "no client ever failed over to the promoted standby "
+                "(the endpoint list was never exercised)")
+        if gb.get("role") != "primary" or gb.get("promotions") != 1:
+            violations.append(
+                f"ledger mismatch: standby ended role="
+                f"{gb.get('role')!r} promotions={gb.get('promotions')} "
+                f"(expected promoted primary, exactly one promotion)")
+        if gb.get("term") != old_term + 1:
+            violations.append(
+                f"ledger mismatch: promoted term {gb.get('term')} "
+                f"(expected {old_term + 1})")
+
+    # ---- alert verdict: failover must FIRE and RESOLVE --------------------
+    if standby is not None and killed and promoted_in is not None:
+        end = time.monotonic() + 5.0
+        while time.monotonic() < end:
+            mission.poll()
+            snap = {a["rule"]: a for a in mission.engine.snapshot()}
+            fa = snap.get("gateway_failover", {})
+            if fa.get("fired_total", 0) > 0 \
+                    and fa.get("state") not in ("pending", "firing"):
+                break
+            time.sleep(mission.params.poll_s)
+    mission.poll()
+    alert_snap = mission.engine.snapshot()
+    mission.stop()
+    fired = sorted(a["rule"] for a in alert_snap if a["fired_total"] > 0)
+    unresolved = sorted(a["rule"] for a in alert_snap
+                        if a["state"] in ("pending", "firing"))
+    expected_alerts = (["gateway_failover"]
+                       if standby is not None and killed else [])
+    unexpected = [r for r in fired if r not in expected_alerts]
+    if unexpected:
+        violations.append(f"unexpected alert(s) fired: {unexpected}")
+    for r in expected_alerts:
+        if r not in fired:
+            violations.append(
+                f"expected alert {r!r} never fired during the gateway "
+                f"outage")
+    if unresolved:
+        violations.append(f"alert(s) {unresolved} still unresolved "
+                          f"after the promoted standby recovered")
+
+    report = {
+        "violations": violations,
+        "actors": actors,
+        "kill_at": kill_at,
+        "no_standby": no_standby,
+        "resurrect": resurrect,
+        "promoted_in_s": (round(promoted_in, 3)
+                          if promoted_in is not None else None),
+        "old_term": old_term,
+        "gateway": gb,
+        "client_failovers": failovers,
+        "acked_chunks": len(acked),
+        "delivered_chunks": len(log.tags),
+        "duplicate_deliveries": len(log.tags) - len(seen),
+        "spilled_rows": len(spilled),
+        "lost_rows": len(lost),
+        "quarantined": quarantined,
+        "poisoned_sent": poisoned_sent,
+        "poisoned_delivered": log.poisoned_delivered,
+        "zombie": zombie_report,
+        "alerts": {"fired": fired, "unexpected": unexpected,
+                   "unresolved": unresolved},
+        "outcomes": {a.slot: a.outcome for a in fleet},
+        "port": primary.port,
+    }
+    if log_dir:
+        flight_recorder.dump_all("gateway chaos drill complete")
+    if tmp_dir is not None:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+    if verbose:
+        for k, v in report.items():
+            if k != "violations":
+                print(f"[chaos] {k}: {v}")
+        for v in violations:
+            print(f"[chaos] VIOLATION: {v}")
+    return report
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools/chaos_soak.py",
@@ -1260,6 +1654,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "while its neighbours pace normally — the "
                          "per-slot fairness drill (calm slots must get "
                          ">= 70%% of their rows through)")
+    ap.add_argument("--kill-gateway", type=float, default=None,
+                    metavar="AT",
+                    help="gateway HA drill (ISSUE 16): kill the primary "
+                         "gateway AT seconds into the run with a live "
+                         "backlog behind it — the warm standby must "
+                         "promote within one lease window (fenced term "
+                         "bump on the shared WAL dir), every client "
+                         "must fail over along its endpoint list, the "
+                         "conservation ledger must stay EXACT "
+                         "(failover_lost counted), and the "
+                         "gateway_failover alert must fire and resolve")
+    ap.add_argument("--no-standby", action="store_true",
+                    help="gateway drill leg: run --kill-gateway WITHOUT "
+                         "a standby — every client must end "
+                         "disconnected exactly as the seed "
+                         "EXIT_DISCONNECTED contract demands")
+    ap.add_argument("--resurrect-primary", action="store_true",
+                    help="gateway drill leg: after promotion, restart "
+                         "the old primary believing its STALE term — "
+                         "its writes must be counted rejects "
+                         "(gateway_term_fenced), never applied")
+    ap.add_argument("--gateway-lease", type=float, default=0.8,
+                    metavar="SECS",
+                    help="gateway drill lease window (promotion "
+                         "deadline after sync silence)")
     ap.add_argument("--kill-replica", type=int, default=None,
                     metavar="AT",
                     help="replica drill (ISSUE 15): SIGKILL-equivalent "
@@ -1295,6 +1714,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="gateway port (0 = ephemeral); pin it so a "
                          "concurrent fleet_top can watch the soak")
     args = ap.parse_args(argv)
+    if args.kill_gateway is not None:
+        report = gateway_soak(
+            seconds=args.seconds, actors=args.actors, seed=args.seed,
+            kill_at=args.kill_gateway, no_standby=args.no_standby,
+            resurrect=args.resurrect_primary,
+            lease_s=args.gateway_lease,
+            poison_every=args.poison_every,
+            log_dir=args.log_dir, port=args.port)
+        ok = not report["violations"]
+        print(f"[chaos] {'OK' if ok else 'FAILED'} gateway drill: "
+              f"{len(report['violations'])} violations")
+        return 0 if ok else 1
     if args.kill_replica is not None or args.hang_replica is not None \
             or args.rejoin:
         kill_at = args.kill_replica

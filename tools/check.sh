@@ -55,6 +55,18 @@ if [ "${APEXLINT_ONLY:-0}" = "1" ]; then
     exit 0
 fi
 
+echo "== stage 1c: gateway failover drill (ISSUE 16) =="
+# the fast HA drill: kill the primary under a live synthetic fleet —
+# the warm standby must promote within one lease window, clients must
+# fail over, the ledger must stay EXACT (failover_lost counted), and
+# the gateway_failover alert must fire and resolve.  Seconds-scale,
+# no jax; a standby that never promotes is a readable nonzero verdict
+if ! JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+        --seconds 6 --kill-gateway 1.5 --gateway-lease 0.6; then
+    echo "gateway failover drill: FAIL"
+    exit 1
+fi
+
 echo "== stage 2: bench --smoke =="
 # covers the fused learner program, the ISSUE-7 device-env engine AND
 # the ISSUE-12 anakin closed-loop pair rate (smoke.anakin_frames_per_sec
@@ -96,6 +108,23 @@ print(f"replica_overhead.replica_overhead_frac = {v}")
 EOF
 then
     echo "replica smoke key: FAIL"
+    exit 1
+fi
+
+echo "== stage 2d: gateway HA smoke key (ISSUE 16) =="
+# the gateway HA-plane overhead fraction must be present and sane — a
+# smoke run that silently dropped the leg would leave the failover
+# plane's cost ungated (stage 3 then holds it under the 0.02 band)
+if ! python - "$tmp/smoke.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+v = d.get("gateway_ha_overhead", {}).get("gateway_ha_overhead_frac")
+assert isinstance(v, (int, float)) and 0 <= v, \
+    f"gateway_ha_overhead.gateway_ha_overhead_frac missing/invalid: {v!r}"
+print(f"gateway_ha_overhead.gateway_ha_overhead_frac = {v}")
+EOF
+then
+    echo "gateway HA smoke key: FAIL"
     exit 1
 fi
 

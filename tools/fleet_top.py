@@ -312,6 +312,39 @@ def replicas_line(status: dict) -> Optional[str]:
     return "  replicas: " + " · ".join(bits)
 
 
+def gateway_line(status: dict) -> Optional[str]:
+    """One panel line for the ISSUE-16 gateway HA plane: the STATUS
+    ``gateway`` block (only present on HA-enabled fleets) — role and
+    fenced term, the standby's sync offset + lag, the journal ledger,
+    and the failover counters.  FENCED is loud: a fenced gateway is
+    refusing every session write by design and an operator staring at
+    a stalled fleet needs to see WHY at a glance."""
+    g = status.get("gateway")
+    if not g:
+        return None
+    head = f"{g.get('role', '?')} term {g.get('term', 0)}"
+    if not g.get("serving", True):
+        head += " (warm — sessions refused until promotion)"
+    if g.get("fenced"):
+        head += " FENCED"
+    bits = [head]
+    if g.get("role") == "standby" or not g.get("serving", True):
+        bits.append(f"sync seq {g.get('sync_seq', 0)} "
+                    f"lag {_fmt_age(g.get('sync_age'))}")
+    bits.append(f"journal seq {g.get('journal_seq', 0)} "
+                f"(+{g.get('journal_appends', 0)} this term)")
+    if g.get("promotions"):
+        bits.append(f"promotions {g['promotions']}")
+    if g.get("failover_lost"):
+        bits.append(f"failover lost {g['failover_lost']} rows (counted)")
+    if g.get("term_fenced") or g.get("standby_refused"):
+        bits.append(f"refused {g.get('term_fenced', 0)} stale-term · "
+                    f"{g.get('standby_refused', 0)} pre-promotion")
+    if g.get("recover_warnings"):
+        bits.append(f"recover warnings {g['recover_warnings']}")
+    return "  gateway: " + " · ".join(bits)
+
+
 def flow_line(status: dict) -> Optional[str]:
     """One panel line for the ISSUE-11 flow-control plane: the STATUS
     ``flow`` block (gateway GatewayFlow.status_block) — overload state
@@ -398,6 +431,9 @@ def render(status: dict,
     rline = replicas_line(status)
     if rline:
         lines.append(rline)
+    gline = gateway_line(status)
+    if gline:
+        lines.append(gline)
     alline = alerts_line(status)
     if alline:
         lines.append(alline)
@@ -500,6 +536,22 @@ def selftest() -> int:
         status = fetch_status(("127.0.0.1", gw.port))
         assert status["alerts"][0]["state"] == "firing", status["alerts"]
         assert "FIRING" in (alerts_line(status) or ""), status["alerts"]
+        # gateway HA panel (ISSUE 16): absent on a non-HA fleet (the
+        # byte-compat contract — no new STATUS key unless enabled),
+        # rendered from the block an HA gateway would publish
+        assert "gateway" not in status, \
+            "non-HA STATUS leaked a 'gateway' block"
+        assert gateway_line(status) is None
+        ha = dict(status, gateway={
+            "role": "standby", "term": 3, "serving": False,
+            "fenced": False, "sync_seq": 17, "sync_age": 0.2,
+            "journal_seq": 17, "journal_appends": 0, "promotions": 0,
+            "failover_lost": 5, "term_fenced": 1, "standby_refused": 2,
+            "recover_warnings": 0})
+        gl = gateway_line(ha) or ""
+        assert "standby" in gl and "term 3" in gl and "lag" in gl, \
+            f"gateway panel line did not render: {gl!r}"
+        json.dumps(ha)  # the --json gateway block stays serializable
     except AssertionError as e:
         print(f"fleet_top --selftest: FAIL: {e}", file=sys.stderr)
         return 1
@@ -616,9 +668,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 panel = render(fetch_status(addr, timeout=args.timeout),
                                latest)
             except (ConnectionError, OSError) as e:
-                panel = (f"gateway {args.gateway} unreachable: {e}\n"
-                         f"  (retrying every {args.interval:g}s — a "
-                         f"restarting gateway comes back on its own)")
+                panel = (f"gateway {args.gateway} unreachable "
+                         f"(retrying): {e}\n"
+                         f"  (refreshing every {args.interval:g}s — a "
+                         f"restarting gateway comes back on its own; "
+                         f"on an HA fleet point this monitor at the "
+                         f"standby too: after failover the promoted "
+                         f"standby is the one answering STATUS)")
             sys.stdout.write("\x1b[2J\x1b[H" + panel + "\n")
             sys.stdout.flush()
             time.sleep(args.interval)
