@@ -1562,6 +1562,195 @@ def bench_gateway_ha_overhead(chunks: int = 600, rows: int = 16,
     return {"gateway_ha_overhead": out}
 
 
+def bench_wire(rows: int = 400, chunk_rows: int = 25,
+               grad_dim: int = 65536, smoke: bool = False) -> dict:
+    """Wire byte economics (ISSUE 18): the bandwidth X-ray's measured
+    baseline for the ROADMAP-4 compression campaign.  Three numbers,
+    all read off the LinkAccountant over REAL client→gateway wires:
+
+    - ``legacy_bytes_per_transition`` — one transition per EXP frame
+      (the pre-PR-4 upload shape: every tick ships its own savez
+      envelope + 9-byte header);
+    - ``bytes_per_transition`` — the production frame-packed shape
+      (``actor_freq``-row chunks, envelope amortized across the chunk)
+      — the headline every compression leg will be gated against;
+    - ``replica_bytes_per_round`` — the ISSUE-15 replica exchange at
+      N=1 with a production-ish 64k-fp32 gradient.
+
+    Byte counts are deterministic (savez layout, fixed geometry), so
+    the gate band is tight — a change here is a wire-format change,
+    not noise."""
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.config import ReplicaParams
+    from pytorch_distributed_tpu.parallel.dcn import (
+        DcnClient, DcnGateway, ReplicaClient, ReplicaRegistry,
+    )
+    from pytorch_distributed_tpu.utils import bandwidth
+    from pytorch_distributed_tpu.utils.experience import Transition
+
+    rounds = 6 if smoke else 20
+    if smoke:
+        rows = min(rows, 100)
+    rows -= rows % chunk_rows  # same row count on both legs
+    z = np.zeros(4, dtype=np.float32)
+    t = Transition(state0=z, action=np.int32(0), reward=np.float32(0.0),
+                   gamma_n=np.float32(0.99), state1=z,
+                   terminal1=np.float32(0.0))
+
+    def ingest_leg(per_frame: int) -> float:
+        bandwidth.reset_for_tests()
+        store = ParamStore(4)
+        store.publish(np.zeros(4, dtype=np.float32))
+        gw = DcnGateway(store, GlobalClock(), ActorStats(),
+                        put_chunk=lambda items: None, host="127.0.0.1",
+                        port=0, pressure=lambda: 0.0)
+        client = DcnClient(("127.0.0.1", gw.port), process_ind=0)
+        chunk = [(t, 1.0)] * per_frame
+        for _ in range(rows // per_frame):
+            client.send_chunk(chunk)
+        acct = bandwidth.get_accountant()
+        bpt = acct.bytes_per_transition()
+        client.close()
+        gw.close()
+        return bpt
+
+    legacy = ingest_leg(1)
+    packed = ingest_leg(chunk_rows)
+
+    # the replica exchange leg: N=1 rounds with a 64k-fp32 gradient
+    bandwidth.reset_for_tests()
+    registry = ReplicaRegistry(ReplicaParams(replicas=1, lease_s=30.0))
+    store = ParamStore(4)
+    store.publish(np.zeros(4, dtype=np.float32))
+    gw = DcnGateway(store, GlobalClock(), ActorStats(),
+                    put_chunk=lambda items: None, host="127.0.0.1",
+                    port=0, replicas=registry)
+    rclient = ReplicaClient(("127.0.0.1", gw.port), 0)
+    rclient.acquire()
+    grad = np.zeros(grad_dim, dtype=np.float32)
+    acct = bandwidth.get_accountant()
+    for r in range(2):  # session setup pays a one-off extra frame
+        rclient.submit_round(r, grad)
+    base_b = sum(acct.totals(link="gateway", verb=v)[0]
+                 for v in ("rlease", "rgrad", "rprio"))
+    base_rounds = acct.rounds
+    for r in range(2, 2 + rounds):
+        rclient.submit_round(r, grad)
+    meas_b = sum(acct.totals(link="gateway", verb=v)[0]
+                 for v in ("rlease", "rgrad", "rprio"))
+    bpr = (meas_b - base_b) / max(acct.rounds - base_rounds, 1)
+    rclient.release()
+    rclient.close()
+    gw.close()
+    bandwidth.reset_for_tests()
+
+    out = {
+        # the headline: the production frame-packed upload shape
+        "bytes_per_transition": round(packed, 1),
+        "legacy_bytes_per_transition": round(legacy, 1),
+        "packing_ratio": round(legacy / packed, 2) if packed else None,
+        "replica_bytes_per_round": round(bpr, 1),
+        "chunk_rows": chunk_rows,
+        "rows": rows,
+        "grad_dim": grad_dim,
+        "geometry": "smoke-wire" if smoke else "wire",
+    }
+    print(f"[bench_wire] {out}", file=sys.stderr, flush=True)
+    return {"wire": out}
+
+
+def bench_wire_overhead(chunks: int = 600, rows: int = 16,
+                        smoke: bool = False) -> dict:
+    """Bandwidth-accountant cost on the ingest hot path (ISSUE 18
+    acceptance): a real DcnClient→DcnGateway wire ingest loop with the
+    plane at its production default (enabled) measures the per-chunk
+    ingest span, and the plane's per-chunk adds — the four
+    ``note_frame`` stamps an EXP round-trip pays (exp tx/rx + ack
+    tx/rx, each a weak socket lookup + one dict get + two int adds
+    under the lock) plus the ``note_transitions`` row count and the
+    flow ledger's byte legs — are DIRECTLY timed in isolation.  The
+    gate number ``wire_overhead_frac`` is plane-work-per-chunk over
+    ingest-span-per-chunk, held under the 0.02 absolute band by
+    bench_gate — the PR-10 lesson applies verbatim: differencing two
+    noisy wire throughputs reads scheduler hiccups as fake overhead,
+    so the rate difference is never the gate number.
+
+    ``smoke=True`` shrinks the loop to sub-second for CI; the
+    measurement logic is identical."""
+    import socket as socket_mod
+
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.parallel.dcn import (
+        T_CLOCK, T_EXP, DcnClient, DcnGateway,
+    )
+    from pytorch_distributed_tpu.utils import bandwidth
+    from pytorch_distributed_tpu.utils.experience import Transition
+
+    wire_iters = 20_000
+    if smoke:
+        chunks = min(chunks, 250)
+        wire_iters = 8_000
+    z = np.zeros(4, dtype=np.float32)
+    t = Transition(state0=z, action=np.int32(0), reward=np.float32(0.0),
+                   gamma_n=np.float32(0.99), state1=z,
+                   terminal1=np.float32(0.0))
+    chunk = [(t, 1.0)] * rows
+    bandwidth.reset_for_tests()
+    store = ParamStore(4)
+    store.publish(np.zeros(4, dtype=np.float32))
+    gw = DcnGateway(store, GlobalClock(), ActorStats(),
+                    put_chunk=lambda items: None, host="127.0.0.1",
+                    port=0, pressure=lambda: 0.0)
+    acct = bandwidth.get_accountant()
+    assert acct is not None, "wire plane off at its production default"
+    client = DcnClient(("127.0.0.1", gw.port), process_ind=0)
+    for _ in range(30):  # session + validator + allocator warmup
+        client.send_chunk(chunk)
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        client.send_chunk(chunk)
+    span = time.perf_counter() - t0
+    # the plane's per-chunk work, timed directly on a registered live
+    # socket (the weak side-table lookup is part of the cost)
+    s1, s2 = socket_mod.socketpair()
+    acct.register_socket(s1, "client", 0)
+    nb = 4096
+    t0 = time.perf_counter()
+    for _ in range(wire_iters):
+        acct.note_frame(s1, T_EXP, nb, "tx")
+        acct.note_frame(s1, T_EXP, nb, "rx")
+        acct.note_frame(s1, T_CLOCK, 64, "tx")
+        acct.note_frame(s1, T_CLOCK, 64, "rx")
+        acct.note_transitions(rows)
+        gw.flow.note_ingested_bytes(nb)
+    wire_s = time.perf_counter() - t0
+    s1.close()
+    s2.close()
+    client.close()
+    gw.close()
+    bandwidth.reset_for_tests()
+    per_chunk = span / max(chunks, 1)
+    per_wire = wire_s / max(wire_iters, 1)
+    out = {
+        "chunks_per_sec_ingest": round(chunks / span, 1),
+        "chunk_ingest_us": round(per_chunk * 1e6, 2),
+        "wire_us_per_chunk": round(per_wire * 1e6, 3),
+        # the gate number: per-chunk accountant work / per-chunk
+        # ingest span
+        "wire_overhead_frac": round(per_wire / per_chunk, 4),
+        "chunk_rows": rows,
+        "geometry": "smoke-wire" if smoke else "wire",
+    }
+    print(f"[bench_wire_overhead] {out}", file=sys.stderr, flush=True)
+    return {"wire_overhead": out}
+
+
 def bench_smoke(updates: int = 384) -> dict:
     """Seconds-scale, CPU-safe bench for CI gating (ISSUE 6 satellite):
     the dqn-mlp learner program fused over a small uniform HBM-style
@@ -2300,7 +2489,7 @@ def main() -> None:
                                        "health", "perf", "device_env",
                                        "provenance", "metrics", "flow",
                                        "anakin", "replica",
-                                       "gateway"),
+                                       "gateway", "wire"),
                     default="both")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale CPU-safe bench (the dqn-mlp "
@@ -2355,6 +2544,12 @@ def main() -> None:
         # additive key, schema stays 4; tools/check.sh stage 2d fails
         # on its absence
         result.update(bench_gateway_ha_overhead(smoke=True))
+        # ISSUE-18 wire byte economics (legacy vs frame-packed
+        # bytes/transition, replica bytes/round) and the accountant's
+        # hot-path cost: additive keys, schema stays 4; tools/check.sh
+        # stage 2e fails on their absence
+        result.update(bench_wire(smoke=True))
+        result.update(bench_wire_overhead(smoke=True))
         # ISSUE-12 co-located loop: the closed rollout+learn pair rate
         # on a tiny fleet (additive key, schema stays 4; the full
         # section with the split-process comparison runs under --mode
@@ -2396,6 +2591,9 @@ def main() -> None:
         result.update(bench_replica_overhead())
     if args.mode in ("both", "gateway"):
         result.update(bench_gateway_ha_overhead())
+    if args.mode in ("both", "wire"):
+        result.update(bench_wire())
+        result.update(bench_wire_overhead())
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))

@@ -45,7 +45,7 @@ from pytorch_distributed_tpu.memory.device_sequence import (
 from pytorch_distributed_tpu.memory.feeder import QueueOwner
 from pytorch_distributed_tpu.utils import checkpoint as ckpt
 from pytorch_distributed_tpu.utils import (
-    flight_recorder, health, perf, tracing,
+    bandwidth, flight_recorder, health, perf, tracing,
 )
 from pytorch_distributed_tpu.utils.faults import FaultInjector
 from pytorch_distributed_tpu.utils.metrics import MetricsWriter
@@ -893,6 +893,12 @@ def run_learner(opt: Options, spec: EnvSpec, process_ind: int, memory: Any,
                         pass  # macOS mp queues have no qsize
                 timing_writer.scalars(perf_mon.drain(step=lstep),
                                       step=lstep)
+            # bandwidth X-ray (ISSUE 18): the headline wire/replay/ckpt
+            # series on the same stats cadence — wire/<link>/bytes_per_s
+            # rates come from deltas against the previous emit
+            wire_series = bandwidth.emit_scalars()
+            if wire_series:
+                timing_writer.scalars(wire_series, step=lstep)
             timing_writer.scalars(timer.drain(), step=lstep)
             _flush_traces(lstep)
             t_cadence = now

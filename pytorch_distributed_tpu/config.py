@@ -124,6 +124,13 @@ KNOBS = (
      "per-field GatewayParams overrides — the ISSUE-16 gateway "
      "high-availability plane (e.g. TPU_APEX_GATEWAY_ENABLED, "
      "TPU_APEX_GATEWAY_LEASE_S, TPU_APEX_GATEWAY_ENDPOINTS)"),
+    ("TPU_APEX_WIRE", "utils/bandwidth.py",
+     "bandwidth-accounting plane switch (shorthand for "
+     "TPU_APEX_WIRE_ENABLED)"),
+    ("TPU_APEX_WIRE_*", "utils/bandwidth.py",
+     "per-field BandwidthParams overrides — the ISSUE-18 byte-exact "
+     "wire/ring/checkpoint accountant (e.g. TPU_APEX_WIRE_SPAWN, "
+     "TPU_APEX_WIRE_RATE_FLOOR_S)"),
 )
 
 
@@ -627,6 +634,33 @@ class FlowParams:
     # sampling, 3 = + oldest experience).  De-escalation rides the
     # same ``recover_s`` hysteresis as the states.
     brownout_dwell_s: float = 5.0
+
+
+@dataclass
+class BandwidthParams:
+    """Byte-exact bandwidth-accounting knobs (ISSUE 18;
+    utils/bandwidth.py — no reference equivalent: the reference counts
+    neither bytes nor frames anywhere).  Every field is
+    env-overridable as ``TPU_APEX_WIRE_<FIELD>`` via
+    ``bandwidth.resolve_bandwidth`` (bare ``TPU_APEX_WIRE=0`` maps to
+    ``enabled``), the same spawn-inheritance contract the
+    flow/perf/metrics planes use.
+
+    ON by default, counter-only hot path: one dict lookup + two
+    integer adds per frame (bench.py ``wire_overhead`` gates it under
+    the 0.02 absolute overhead band)."""
+
+    # Master switch.  Off = no counters, no wire/* series, no byte
+    # legs in the flow conservation ledger.
+    enabled: bool = True
+    # Account spawn-queue mint/drain boundaries (QueueFeeder flush,
+    # QueueOwner / DeviceReplayIngest drain) — linear in chunk rows at
+    # flush cadence, not per-frame; off leaves only the wire planes.
+    spawn: bool = True
+    # Minimum seconds between emit_scalars snapshots for a
+    # ``wire/<link>/bytes_per_s`` rate to be computed (guards the
+    # delta against a ~0 denominator on back-to-back emits).
+    rate_floor_s: float = 0.05
 
 
 @dataclass

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_distributed_tpu.utils import experience
+from pytorch_distributed_tpu.utils import bandwidth, experience
 from pytorch_distributed_tpu.utils.experience import (
     REPLAY_FIELDS, Batch, Transition,
 )
@@ -474,6 +474,7 @@ class DeviceReplayIngest:
         at construction by the memory factory)."""
         self.replay = self._make_replay(round_capacity(self.capacity, mesh),
                                         mesh)
+        bandwidth.note_device_replay(self.replay.state)
         return self.replay
 
     def attach_halves(self, mesh: Optional[jax.sharding.Mesh] = None
@@ -491,6 +492,8 @@ class DeviceReplayIngest:
                              label="anakin half ring")
         self.replay = self._make_replay(cap, mesh)
         self.replay_b = self._make_replay(cap, mesh)
+        bandwidth.note_device_replay(self.replay.state,
+                                     self.replay_b.state)
         return self.replay, self.replay_b
 
     def note_scatter(self, rows: int) -> None:

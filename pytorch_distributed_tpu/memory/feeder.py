@@ -21,7 +21,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from pytorch_distributed_tpu.utils import tracing
+from pytorch_distributed_tpu.utils import bandwidth, tracing
 from pytorch_distributed_tpu.utils.experience import Transition
 
 _CTX = mp.get_context("spawn")
@@ -170,6 +170,10 @@ class QueueFeeder:
                 traced = False
         chunk = (tracing.TracedChunk(self._buf)  # mint, no wire columns
                  if traced else self._buf)
+        # bandwidth X-ray (ISSUE 18): the spawn-plane mint boundary —
+        # drops downstream are the flow ring's counted shed, so mint
+        # here is a plane counter, not a ledger leg
+        bandwidth.note_spawn("mint", chunk)
         t0 = time.perf_counter()
         delivered = True
         if self._flow_ring is not None:
@@ -217,6 +221,7 @@ def pop_chunks(q, max_chunks: int = 1024) -> List[Tuple[Transition,
     actor→learner trace)."""
     out: List[Tuple[Transition, Optional[float]]] = []
     tracer = tracing.get_tracer("feeder")
+    popped = 0
     for _ in range(max_chunks):
         try:
             chunk = q.get_nowait()
@@ -225,6 +230,10 @@ def pop_chunks(q, max_chunks: int = 1024) -> List[Tuple[Transition,
         if isinstance(chunk, tracing.TracedChunk):
             tracer.record_hop("feed", chunk.born, chunk.trace_id)
         out.extend(chunk)
+        popped += 1
+    # the shared drain boundary: one stamp covers QueueOwner and
+    # DeviceReplayIngest alike (bandwidth X-ray, ISSUE 18)
+    bandwidth.note_spawn("drain", out, frames=popped)
     return out
 
 

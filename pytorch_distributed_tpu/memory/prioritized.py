@@ -24,6 +24,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from pytorch_distributed_tpu.memory.base import Memory
+from pytorch_distributed_tpu.utils import bandwidth
 from pytorch_distributed_tpu.utils.experience import (
     REPLAY_FIELDS, Batch, Transition,
 )
@@ -62,6 +63,9 @@ class PrioritizedReplay(Memory):
         self._pos = 0
         self._full = False
         self._samples_drawn = 0
+        # replay occupancy gauge (bandwidth X-ray, ISSUE 18): columns
+        # are preallocated, so one shot here is accurate for the run
+        bandwidth.note_host_replay(self)
 
     @property
     def size(self) -> int:

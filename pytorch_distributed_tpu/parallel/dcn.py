@@ -127,8 +127,8 @@ import numpy as np
 
 from pytorch_distributed_tpu.agents.param_store import ParamStore
 from pytorch_distributed_tpu.memory.feeder import QueueFeeder
-from pytorch_distributed_tpu.utils import experience, flight_recorder, \
-    flow, tracing
+from pytorch_distributed_tpu.utils import bandwidth, experience, \
+    flight_recorder, flow, tracing
 from pytorch_distributed_tpu.utils.experience import Transition
 from pytorch_distributed_tpu.utils.faults import FaultInjector
 
@@ -190,6 +190,16 @@ T_SYNC = 15     # JSON {since} -> JSON {term, seq, base_seq, records,
 
 _MAX_FRAME = 1 << 31  # 2 GiB — far above any chunk; rejects garbage lengths
 
+# verb names for the bandwidth X-ray (utils/bandwidth.py): registered
+# here so the accountant never imports this module (no import cycle)
+bandwidth.register_verbs({
+    T_HELLO: "hello", T_EXP: "exp", T_GETP: "getp", T_PARAMS: "params",
+    T_CLOCK: "clock", T_TICK: "tick", T_BYE: "bye", T_PING: "ping",
+    T_STATUS: "status", T_PROFILE: "profile", T_METRICS: "metrics",
+    T_RLEASE: "rlease", T_RGRAD: "rgrad", T_RPRIO: "rprio",
+    T_SYNC: "sync",
+})
+
 
 def _env_float(name: str, default: float) -> float:
     try:
@@ -199,6 +209,12 @@ def _env_float(name: str, default: float) -> float:
 
 
 def _send_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
+    # stamp BEFORE sendall so the tx note happens-before the peer's
+    # reply can complete an RPC — a reader polling the accountant after
+    # a synchronous round-trip must never observe the request counted
+    # but the reply missing (byte-exact means exact at every quiescent
+    # point, not eventually)
+    bandwidth.note_frame(sock, ftype, _HDR.size + len(payload), "tx")
     sock.sendall(_HDR.pack(ftype, len(payload)) + payload)
 
 
@@ -216,7 +232,9 @@ def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
     ftype, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
     if length > _MAX_FRAME:
         raise ConnectionError(f"oversized frame: {length}")
-    return ftype, _recv_exact(sock, length) if length else b""
+    payload = _recv_exact(sock, length) if length else b""
+    bandwidth.note_frame(sock, ftype, _HDR.size + length, "rx")
+    return ftype, payload
 
 
 # ---------------------------------------------------------------------------
@@ -1243,6 +1261,7 @@ class ReplicaRegistry:
         rnd["done"] = True
         self._round_done = max(self._round_done, round_idx)
         self.rounds_completed += 1
+        bandwidth.note_round()
         if len(ids) < rnd["starting_members"]:
             self.degraded_completions += 1
             self._recorder.record("round-degraded", round=round_idx,
@@ -1550,6 +1569,8 @@ class ReplicaClient:
                             self.address, timeout=5.0)
                         sock.setsockopt(socket.IPPROTO_TCP,
                                         socket.TCP_NODELAY, 1)
+                        bandwidth.register_socket(sock, "replica",
+                                                  self.replica)
                         setattr(self, attr, sock)
                     sock.settimeout(timeout)
                     _send_frame(sock, ftype, payload)
@@ -1878,6 +1899,8 @@ class DcnGateway:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # slot is unknown until HELLO; the serve loop re-registers
+            bandwidth.register_socket(conn, "gateway")
             self.connections += 1
             with self._slots_lock:
                 self._conns.add(conn)
@@ -2004,10 +2027,21 @@ class DcnGateway:
         sync stream ships, so re-applying any suffix is idempotent."""
         led = {"ingested": int(self._ha_carry.get("ingested", 0)),
                "shed": int(self._ha_carry.get("shed", 0)),
-               "quarantined": int(self._ha_carry.get("quarantined", 0))}
+               "quarantined": int(self._ha_carry.get("quarantined", 0)),
+               "ingested_bytes":
+                   int(self._ha_carry.get("ingested_bytes", 0)),
+               "rejected_bytes":
+                   int(self._ha_carry.get("rejected_bytes", 0)),
+               "shed_bytes": int(self._ha_carry.get("shed_bytes", 0))}
         if self._flow is not None:
             led["ingested"] += int(self._flow.ingested_rows)
             led["shed"] += int(sum(self._flow.shed_rows.values()))
+            # byte legs (ISSUE 18) ride the same absolute-cumulative
+            # contract as the row legs, so re-applying any journal
+            # suffix stays idempotent
+            led["ingested_bytes"] += int(self._flow.ingested_bytes)
+            led["rejected_bytes"] += int(self._flow.rejected_bytes)
+            led["shed_bytes"] += int(self._flow.shed_bytes)
         with self._slots_lock:
             led["quarantined"] += int(sum(self.quarantined.values()))
         return led
@@ -2058,7 +2092,9 @@ class DcnGateway:
                         if int(q) > self._tick_seq.get(si, -1):
                             self._tick_seq[si] = int(q)
                 led = data.get("ledger") or {}
-                for k in ("ingested", "shed", "quarantined"):
+                for k in ("ingested", "shed", "quarantined",
+                          "ingested_bytes", "rejected_bytes",
+                          "shed_bytes"):
                     v = int(led.get(k, 0))
                     if v > self._ha_carry.get(k, 0):
                         self._ha_carry[k] = v
@@ -2110,6 +2146,7 @@ class DcnGateway:
                                             timeout=timeout)
         except OSError:
             return False
+        bandwidth.register_socket(sock, "sync")
         try:
             sock.settimeout(timeout)
             _send_frame(sock, T_SYNC,
@@ -2244,6 +2281,21 @@ class DcnGateway:
             # conservation ledger — fleet_top's ``flow:`` panel line
             snap["flow"] = self._flow.status_block(
                 quarantined=sum(snap["quarantined"].values()))
+        wire_blk = bandwidth.status_block()
+        if wire_blk is not None:
+            # bandwidth X-ray (ISSUE 18): per-link byte/frame totals,
+            # bytes/transition + bytes/round, and the byte-ledger
+            # verdict joined from the flow block's conservation —
+            # fleet_top's ``wire:`` panel line
+            if self._flow is not None:
+                cons = snap.get("flow", {}).get("conservation", {})
+                wire_blk["ledger"] = {
+                    k: cons[k] for k in (
+                        "acked_bytes", "ingested_bytes",
+                        "rejected_bytes", "shed_bytes",
+                        "accounted_bytes", "bytes_balanced")
+                    if k in cons}
+            snap["wire"] = wire_blk
         if self._replicas is not None:
             # replica plane (ISSUE 15): membership/generation/lease ages
             # + the fencing ledger — fleet_top's ``replicas:`` panel
@@ -2561,6 +2613,13 @@ class DcnGateway:
                         _send_frame(conn, T_SYNC,
                                     json.dumps(reply).encode())
                     elif ftype == T_EXP:
+                        # byte-ledger granularity is the FRAME: every
+                        # acked EXP payload lands in exactly one of
+                        # {rejected, shed, ingested} byte buckets
+                        # (quarantine is a row-level refinement inside
+                        # the ingested frame).  Header-free, matching
+                        # the client's acked_bytes count at encode.
+                        exp_nbytes = len(payload)
                         try:
                             items = decode_chunk(payload)
                         except ConnectionError:
@@ -2574,6 +2633,10 @@ class DcnGateway:
                             # ack, and drop the FRAME instead; the
                             # session survives.
                             self.frames_rejected += 1
+                            if self._flow is not None:
+                                # acked below — the frame's bytes must
+                                # land in the rejected ledger bucket
+                                self._flow.note_rejected_bytes(exp_nbytes)
                             self._recorder.record("frame-rejected",
                                                   slot=slot,
                                                   error=str(e)[:200])
@@ -2606,8 +2669,18 @@ class DcnGateway:
                             self._tracer.record_hop("gateway", items.born,
                                                     items.trace_id)
                         admitted = (self._flow is None
-                                    or self._flow.admit(slot, len(items)))
+                                    or self._flow.admit(
+                                        slot, len(items),
+                                        nbytes=exp_nbytes))
                         if admitted:
+                            if self._flow is not None:
+                                # ingested-BYTES counts the whole
+                                # admitted frame even if quarantine
+                                # empties it (the rows land in the
+                                # quarantined row bucket; the bytes
+                                # stay frame-granular)
+                                self._flow.note_ingested_bytes(
+                                    exp_nbytes)
                             items = self._quarantine(slot, items)
                         else:
                             # the gateway's ONE declared experience shed
@@ -2617,6 +2690,7 @@ class DcnGateway:
                             # very load being shed
                             items = []
                         if items:
+                            bandwidth.note_transitions(len(items))
                             if self._flow is not None:
                                 # ingested = admitted AND clean of the
                                 # quarantine: each row lands in exactly
@@ -2699,6 +2773,8 @@ class DcnGateway:
                                         json.dumps(reply).encode())
                             return
                         slot = ind
+                        # the accept loop registered this conn slotless
+                        bandwidth.register_socket(conn, "gateway", slot)
                         if self._ha and ind is not None:
                             # journal the claim (absolute incarnation:
                             # idempotent) so the standby fences stale
@@ -2793,6 +2869,7 @@ def _sessionless_rpc(address: Tuple[str, int], ftype: int, payload: bytes,
     for attempt in (0, 1):
         try:
             sock = socket.create_connection(address, timeout=timeout)
+            bandwidth.register_socket(sock, "probe")
         except OSError as e:
             last = e
             if attempt == 0:
@@ -2995,6 +3072,7 @@ class DcnClient:
             self._flow_params.client_ring, owner=process_ind)
         self.flow_minted_rows = 0   # rows offered to send_chunk
         self.flow_acked_rows = 0    # rows the wire acknowledged
+        self.flow_acked_bytes = 0   # EXP payload bytes acknowledged
         self._flow_blocked_logged = False
         # estimated wall-clock offset to the gateway host (seconds to ADD
         # to local time.time() to land on the gateway's clock), derived
@@ -3031,6 +3109,8 @@ class DcnClient:
                 self.address = self.endpoints[self._ep]
                 self._sock = socket.create_connection(self.address,
                                                       timeout=30.0)
+                bandwidth.register_socket(self._sock, "client",
+                                          process_ind)
                 break
             except OSError:
                 if time.monotonic() > deadline or retries <= 0:
@@ -3165,6 +3245,7 @@ class DcnClient:
                                 and self._reply_deadline > 0
                                 else remaining))
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            bandwidth.register_socket(sock, "client", self.process_ind)
             self.incarnation += 1
             try:
                 _send_frame(sock, T_HELLO, self._hello_payload())
@@ -3276,11 +3357,19 @@ class DcnClient:
                 and self.credits <= 0)
 
     def _send_exp(self, items: list) -> None:
-        """One credit-consuming EXP round-trip (the reply re-grants)."""
+        """One credit-consuming EXP round-trip (the reply re-grants).
+
+        Byte ledger (ISSUE 18): the payload is encoded ONCE and its
+        bytes counted ONCE after the ack — ``_request``'s retransmits
+        resend the same frame, so ``flow_acked_bytes`` is
+        retransmit-idempotent by construction (exactly like the row
+        count below)."""
         if self.credits is not None:
             self.credits -= 1
-        self._request(T_EXP, encode_chunk(items))
+        payload = encode_chunk(items)
+        self._request(T_EXP, payload)
         self.flow_acked_rows += len(items)
+        self.flow_acked_bytes += len(payload)
 
     def send_chunk(self, items: list) -> None:
         """Ship one chunk, credit-aware (ISSUE 11).  With send credit
@@ -3319,6 +3408,7 @@ class DcnClient:
         them)."""
         return {"minted": self.flow_minted_rows,
                 "acked": self.flow_acked_rows,
+                "acked_bytes": self.flow_acked_bytes,
                 "dropped": self.flow_ring.dropped_rows,
                 "buffered": self.flow_ring.buffered_rows}
 

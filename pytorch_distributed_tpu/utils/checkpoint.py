@@ -571,6 +571,16 @@ def save_epoch(model_name: str, state: Any = None, memory: Any = None,
         "artifacts": artifacts,
     })
     faults.frame()  # post_commit
+    # bandwidth X-ray (ISSUE 18): per-epoch byte gauges off the
+    # already-digested artifact sizes — zero extra I/O
+    from pytorch_distributed_tpu.utils import bandwidth
+
+    epoch_bytes = sum(int(m.get("bytes", 0)) for m in artifacts.values())
+    bandwidth.set_gauge("ckpt/epoch_bytes", float(epoch_bytes))
+    for name, meta in artifacts.items():
+        bandwidth.set_gauge(f"ckpt/epoch_bytes/{name}",
+                            float(meta.get("bytes", 0)))
+        bandwidth.note("ckpt", name, int(meta.get("bytes", 0)), "tx")
     gc_epochs(root, retain=retain, in_progress=k)
     return ed
 
@@ -650,6 +660,12 @@ def verify_epoch(path: str) -> Tuple[str, List[str]]:
         if digest != meta.get("sha256"):
             bad.append(f"{ap}: content digest mismatch "
                        f"(torn or modified after commit)")
+        if meta.get("bytes") is not None \
+                and int(meta["bytes"]) != int(nbytes):
+            bad.append(f"{ap}: size mismatch — manifest says "
+                       f"{int(meta['bytes'])} bytes, on disk "
+                       f"{int(nbytes)} (truncated or padded after "
+                       f"commit)")
     if "extras.json" in arts and not any("extras.json" in b for b in bad):
         try:
             with open(os.path.join(path, "extras.json")) as f:
@@ -800,7 +816,14 @@ def fsck(root: str) -> dict:
         entry = {"epoch": k, "status": status, "violations": bad}
         if status in ("complete", "rolled-back"):
             with open(os.path.join(path, MANIFEST)) as f:
-                entry["learner_step"] = json.load(f).get("learner_step")
+                man = json.load(f)
+            entry["learner_step"] = man.get("learner_step")
+            # per-artifact byte sizes (bandwidth X-ray, ISSUE 18) —
+            # what tools/ckpt_fsck.py prints per epoch
+            entry["artifacts"] = {
+                name: int(meta.get("bytes", 0))
+                for name, meta in (man.get("artifacts") or {}).items()}
+            entry["bytes"] = sum(entry["artifacts"].values())
         if status == "complete":
             if report["newest_complete"] is None:
                 report["newest_complete"] = k

@@ -445,6 +445,18 @@ class GatewayFlow:
         self.shed_rows: Dict[int, int] = {}
         self.client_reports: Dict[int, Dict[str, int]] = {}
         self._shed_logged = 0
+        # byte legs of the conservation ledger (ISSUE 18): every acked
+        # EXP frame's payload bytes land in exactly ONE of these —
+        # rejected (schema-invalid, acked), shed (admit False, acked),
+        # or ingested (everything else; quarantine refines rows, not
+        # bytes).  The client's matching cumulative ``acked_bytes``
+        # rides its tick report.
+        self.ingested_bytes = 0
+        self.rejected_bytes = 0
+        self.shed_bytes = 0
+        # rung attribution: brownout tier -> shed bytes (the --flood
+        # drill reports bytes shed per rung)
+        self.shed_bytes_by_tier: Dict[int, int] = {}
 
     # -- plumbing ------------------------------------------------------------
 
@@ -477,7 +489,8 @@ class GatewayFlow:
 
     # -- the two hot-path reads ----------------------------------------------
 
-    def admit(self, slot: Optional[int], rows: int) -> bool:
+    def admit(self, slot: Optional[int], rows: int,
+              nbytes: int = 0) -> bool:
         """Gateway-side admission for one decoded EXP chunk.  Always
         meters the slot's bucket (so fairness accounting is live before
         overload); only SHEDS — returns False — at brownout tier 3 with
@@ -490,6 +503,10 @@ class GatewayFlow:
             with self._lock:
                 self.shed_chunks += 1
                 self.shed_rows[s] = self.shed_rows.get(s, 0) + int(rows)
+                self.shed_bytes += int(nbytes)
+                tier = self.governor.tier
+                self.shed_bytes_by_tier[tier] = \
+                    self.shed_bytes_by_tier.get(tier, 0) + int(nbytes)
                 self._shed_logged += 1
                 log_it = self._shed_logged <= 3
             if self._recorder is not None:
@@ -508,6 +525,19 @@ class GatewayFlow:
         quarantined row lands in exactly one bucket."""
         with self._lock:
             self.ingested_rows += int(rows)
+
+    def note_ingested_bytes(self, nbytes: int) -> None:
+        """Count an admitted EXP frame's payload bytes (frame-granular:
+        counted even when quarantine empties the chunk — its rows land
+        in the quarantined bucket, its bytes stay here)."""
+        with self._lock:
+            self.ingested_bytes += int(nbytes)
+
+    def note_rejected_bytes(self, nbytes: int) -> None:
+        """Count a schema-rejected (but acked) EXP frame's payload
+        bytes — the ``framed-reject`` leg of the byte ledger."""
+        with self._lock:
+            self.rejected_bytes += int(nbytes)
 
     def grant(self, slot: Optional[int]) -> Optional[int]:
         """Credit grant for the slot's next ack; None = no credit field
@@ -531,7 +561,8 @@ class GatewayFlow:
         if slot is None or not isinstance(report, dict):
             return
         clean: Dict[str, int] = {}
-        for k in ("minted", "acked", "dropped", "buffered"):
+        for k in ("minted", "acked", "acked_bytes", "dropped",
+                  "buffered"):
             try:
                 clean[k] = int(report.get(k, 0))
             except (TypeError, ValueError):
@@ -559,9 +590,13 @@ class GatewayFlow:
             reports = {s: dict(r) for s, r in self.client_reports.items()}
             gw_shed = sum(self.shed_rows.values())
             ingested = self.ingested_rows
+            ingested_b = self.ingested_bytes
+            rejected_b = self.rejected_bytes
+            shed_b = self.shed_bytes
         minted = sum(r["minted"] for r in reports.values())
         dropped = sum(r["dropped"] for r in reports.values())
         buffered = sum(r["buffered"] for r in reports.values())
+        acked_b = sum(r.get("acked_bytes", 0) for r in reports.values())
         out = {
             "minted": minted,
             "ingested": ingested,
@@ -569,6 +604,14 @@ class GatewayFlow:
             "shed_gateway": gw_shed,
             "quarantined": int(quarantined),
             "buffered_client": buffered,
+            # the byte ledger (ISSUE 18): every acked EXP payload byte
+            # is ingested, framed-rejected, or gateway-shed; unlike
+            # rows there is no client-side byte bucket — ring-dropped
+            # chunks are never encoded, so their bytes never exist
+            "acked_bytes": acked_b,
+            "ingested_bytes": ingested_b,
+            "rejected_bytes": rejected_b,
+            "shed_bytes": shed_b,
             "reporting_slots": sorted(reports),
         }
         if reports:
@@ -576,6 +619,12 @@ class GatewayFlow:
                          + int(quarantined) + buffered)
             out["accounted"] = accounted
             out["balanced"] = bool(minted <= accounted)
+            # one-sided for the same reason as rows: client counters
+            # are tick-cadence stale while the gateway legs are
+            # real-time, and legacy peers ingest bytes with no report
+            accounted_b = ingested_b + rejected_b + shed_b
+            out["accounted_bytes"] = accounted_b
+            out["bytes_balanced"] = bool(acked_b <= accounted_b)
         return out
 
     def status_block(self, quarantined: int = 0) -> dict:

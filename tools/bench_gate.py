@@ -77,6 +77,12 @@ SPECS: List[Tuple[str, str, str]] = [
     ("replica_overhead.replica_overhead_frac", "lower_abs", "overhead"),
     ("gateway_ha_overhead.gateway_ha_overhead_frac", "lower_abs",
      "overhead"),
+    # ISSUE-18 wire byte economics: deterministic counts (savez layout
+    # at fixed geometry), so a regression here is a wire-format change
+    # — the compression campaign must move these DOWN, never up
+    ("wire.bytes_per_transition", "lower_rel", "wire"),
+    ("wire.replica_bytes_per_round", "lower_rel", "wire"),
+    ("wire_overhead.wire_overhead_frac", "lower_abs", "overhead"),
     ("device_env.host_frames_per_sec", "higher", "device_env"),
     ("device_env.device_frames_per_sec", "higher", "device_env"),
     ("device_env.fused_frames_per_sec", "higher", "device_env"),
@@ -110,6 +116,9 @@ DEFAULT_TOL: Dict[str, float] = {
     # adds spawn-queue scheduling jitter on loaded hosts
     "anakin": 0.30,
     "smoke": 0.40,      # CPU-host scheduling noise is large at small K
+    # byte counts are layout-deterministic; the slack only covers savez
+    # header drift across numpy versions
+    "wire": 0.10,
 }
 
 

@@ -768,6 +768,31 @@ def soak(seconds: float = 20.0, actors: int = 3, seed: int = 0,
                 f"{delivered} + dropped {dropped} + gw-shed {gw_shed} "
                 f"+ quarantined {quarantined} + buffered {buffered} "
                 f"= {accounted} (uncounted drop somewhere)")
+        # byte ledger (ISSUE 18): at quiescence every acked EXP
+        # payload byte is in exactly one gateway bucket — EXACT, even
+        # under brownout (shed bytes counted, never silently lost).
+        # Ring-dropped chunks are never encoded, so their bytes never
+        # exist; buffered chunks were never acked.
+        acked_bytes = sum(a.client.flow_acked_bytes
+                          for a in fleet if a.client)
+        accounted_bytes = (gw.flow.ingested_bytes
+                           + gw.flow.rejected_bytes
+                           + gw.flow.shed_bytes)
+        flow_report["acked_bytes"] = acked_bytes
+        flow_report["ingested_bytes"] = gw.flow.ingested_bytes
+        flow_report["rejected_bytes"] = gw.flow.rejected_bytes
+        flow_report["shed_bytes"] = gw.flow.shed_bytes
+        # bytes shed per brownout rung (tier -> bytes)
+        flow_report["shed_bytes_by_tier"] = {
+            str(t): int(n)
+            for t, n in sorted(gw.flow.shed_bytes_by_tier.items())}
+        if acked_bytes != accounted_bytes:
+            violations.append(
+                f"byte conservation breached: acked {acked_bytes} B "
+                f"!= ingested {gw.flow.ingested_bytes} + rejected "
+                f"{gw.flow.rejected_bytes} + shed "
+                f"{gw.flow.shed_bytes} = {accounted_bytes} B "
+                f"(uncounted bytes somewhere)")
         if gov.transitions == 0:
             violations.append(
                 "overload never engaged: the governor sat in 'healthy' "
@@ -1520,6 +1545,53 @@ def gateway_soak(seconds: float = 8.0, actors: int = 3, seed: int = 0,
         violations.append(
             f"{poisoned_sent} poisoned chunks sent but neither "
             f"gateway quarantined any")
+    # ---- byte-ledger verdict across the cutover (ISSUE 18) ----------------
+    # Every acked EXP payload byte must be accounted by SOME gateway's
+    # counted buckets (no uncounted loss).  One-sided on purpose: a
+    # frame the dying primary processed whose ack never landed is
+    # retransmitted to (and re-counted by) the standby — the same
+    # documented lost-ack residual the row ledger carries, so the
+    # gateway legs may LEAD the client count, never trail it.
+    wire_report: dict = {}
+    if standby is not None and killed and promoted_in is not None \
+            and primary.flow is not None and standby.flow is not None:
+        acked_bytes = sum(a.client.flow_acked_bytes
+                          for a in fleet if a.client)
+        primary_bytes = (primary.flow.ingested_bytes
+                         + primary.flow.rejected_bytes
+                         + primary.flow.shed_bytes)
+        standby_bytes = (standby.flow.ingested_bytes
+                         + standby.flow.rejected_bytes
+                         + standby.flow.shed_bytes)
+        carry = {k: int(v) for k, v in (gb.get("carry") or {}).items()
+                 if k.endswith("_bytes")}
+        wire_report = {
+            "acked_bytes": acked_bytes,
+            "primary_bytes": primary_bytes,
+            "standby_bytes": standby_bytes,
+            "journal_carry": carry,
+            "retransmit_residual_bytes":
+                primary_bytes + standby_bytes - acked_bytes,
+        }
+        if acked_bytes > primary_bytes + standby_bytes:
+            violations.append(
+                f"byte conservation breached across failover: clients "
+                f"acked {acked_bytes} B but the two gateways account "
+                f"only {primary_bytes + standby_bytes} B (uncounted "
+                f"bytes lost in the cutover)")
+        if carry.get("ingested_bytes", 0) > primary.flow.ingested_bytes:
+            violations.append(
+                f"journaled byte carry LEADS the primary's own ledger "
+                f"({carry.get('ingested_bytes')} > "
+                f"{primary.flow.ingested_bytes} B) — the journal "
+                f"invented bytes")
+        if primary.flow.ingested_bytes \
+                and not carry.get("ingested_bytes"):
+            violations.append(
+                f"journaled byte carry empty despite "
+                f"{primary.flow.ingested_bytes} B ingested before the "
+                f"kill (byte legs not riding the HA state records)")
+
     failovers = sum(a.client.failovers for a in fleet if a.client)
     if standby is not None and killed and promoted_in is not None:
         if failovers < 1:
@@ -1573,6 +1645,7 @@ def gateway_soak(seconds: float = 8.0, actors: int = 3, seed: int = 0,
         "kill_at": kill_at,
         "no_standby": no_standby,
         "resurrect": resurrect,
+        "wire": wire_report,
         "promoted_in_s": (round(promoted_in, 3)
                           if promoted_in is not None else None),
         "old_term": old_term,

@@ -128,6 +128,28 @@ then
     exit 1
 fi
 
+echo "== stage 2e: wire smoke keys (ISSUE 18) =="
+# the bandwidth X-ray's headline (frame-packed bytes/transition) must
+# be present and NONZERO — a zero here means the accountant stopped
+# stamping the EXP plane — and the accountant's hot-path cost must be
+# present and sane (stage 3 then holds it under the 0.02 band)
+if ! python - "$tmp/smoke.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+v = d.get("wire", {}).get("bytes_per_transition")
+assert isinstance(v, (int, float)) and v > 0, \
+    f"wire.bytes_per_transition missing/zero: {v!r}"
+print(f"wire.bytes_per_transition = {v}")
+f = d.get("wire_overhead", {}).get("wire_overhead_frac")
+assert isinstance(f, (int, float)) and 0 <= f, \
+    f"wire_overhead.wire_overhead_frac missing/invalid: {f!r}"
+print(f"wire_overhead.wire_overhead_frac = {f}")
+EOF
+then
+    echo "wire smoke keys: FAIL"
+    exit 1
+fi
+
 echo "== stage 3: bench_gate vs BENCH_SMOKE_BASELINE.json =="
 # generous smoke tolerance: this stage pins the pipeline on any host;
 # same-machine perf gating uses the recorded history (TESTING.md)

@@ -82,8 +82,17 @@ def main(argv=None) -> int:
                    f"{e['status']}"
             if e["status"] in ("complete", "rolled-back") \
                     and e.get("learner_step") is not None:
-                line += f" (learner_step {e.get('learner_step')})"
+                line += f" (learner_step {e.get('learner_step')}"
+                if e.get("bytes") is not None:
+                    line += f", {e['bytes']} bytes"
+                line += ")"
             print(line)
+            # per-artifact byte sizes (bandwidth X-ray, ISSUE 18):
+            # the MANIFEST-recorded sizes verify_epoch checked against
+            # the on-disk artifacts — a disagreement is a VIOLATION
+            # line below, not a silent skew
+            for name, nb in sorted((e.get("artifacts") or {}).items()):
+                print(f"[ckpt_fsck]   {name}: {nb} bytes")
             for v in e["violations"]:
                 print(f"[ckpt_fsck]   VIOLATION: {v}")
         if rep.get("rolled_back"):

@@ -387,6 +387,43 @@ def flow_line(status: dict) -> Optional[str]:
     return "  flow: " + " · ".join(bits)
 
 
+def wire_line(status: dict) -> Optional[str]:
+    """One panel line for the ISSUE-18 bandwidth X-ray: the STATUS
+    ``wire`` block — per-link cumulative bytes (with tx/rx split where
+    both flow), bytes/transition and bytes/round, the replay/ckpt
+    gauges, and the byte-ledger verdict (IMBALANCED loud: acked bytes
+    no counted gateway bucket can explain)."""
+    w = status.get("wire")
+    if not w:
+        return None
+    bits: List[str] = []
+    links = w.get("links") or {}
+    for lk in sorted(links):
+        d = links[lk]
+        bits.append(f"{lk} {_fmt_bytes(d.get('bytes', 0))}"
+                    f"/{d.get('frames', 0)}f")
+    bpt = w.get("bytes_per_transition")
+    if bpt:
+        bits.append(f"{bpt:g} B/transition")
+    bpr = w.get("replica_bytes_per_round")
+    if bpr:
+        bits.append(f"{bpr:g} B/round")
+    gauges = w.get("gauges") or {}
+    if gauges.get("replay/hbm_bytes"):
+        bits.append(f"replay {_fmt_bytes(gauges['replay/hbm_bytes'])}")
+    if gauges.get("ckpt/epoch_bytes"):
+        bits.append(f"ckpt {_fmt_bytes(gauges['ckpt/epoch_bytes'])}")
+    led = w.get("ledger") or {}
+    if "bytes_balanced" in led:
+        bits.append("ledger " + (
+            "ok" if led["bytes_balanced"] else
+            f"IMBALANCED ({led.get('acked_bytes')} acked vs "
+            f"{led.get('accounted_bytes')} accounted bytes)"))
+    if not bits:
+        return None
+    return "  wire: " + " · ".join(bits)
+
+
 def render(status: dict,
            metrics_latest: Optional[Dict[str, float]] = None) -> str:
     """One snapshot as a plain-text panel (no curses: works in any
@@ -440,6 +477,9 @@ def render(status: dict,
     fline = flow_line(status)
     if fline:
         lines.append(fline)
+    wline = wire_line(status)
+    if wline:
+        lines.append(wline)
     lines.extend(series_lines(status))
     # health sentinel (utils/health.py): guard skips / rollbacks / hang
     # kills from the learner host, quarantine counts split by boundary —
@@ -542,6 +582,22 @@ def selftest() -> int:
         assert "gateway" not in status, \
             "non-HA STATUS leaked a 'gateway' block"
         assert gateway_line(status) is None
+        # bandwidth X-ray (ISSUE 18): the STATUS probe itself moved
+        # frames, so a real gateway must publish a non-empty wire
+        # block and the panel line must render from it
+        wire = status.get("wire") or {}
+        assert wire.get("links"), \
+            f"STATUS missing/empty wire block: {sorted(status)}"
+        assert "gateway" in wire["links"], \
+            f"gateway link unaccounted: {sorted(wire['links'])}"
+        wl = wire_line(status) or ""
+        assert wl.startswith("  wire:"), \
+            f"wire panel line did not render: {wl!r}"
+        imb = dict(status, wire=dict(
+            wire, ledger={"acked_bytes": 100, "accounted_bytes": 60,
+                          "bytes_balanced": False}))
+        assert "IMBALANCED" in (wire_line(imb) or ""), \
+            "imbalanced byte ledger not loud in the wire panel line"
         ha = dict(status, gateway={
             "role": "standby", "term": 3, "serving": False,
             "fenced": False, "sync_seq": 17, "sync_age": 0.2,

@@ -67,6 +67,13 @@ _DEFAULT_SCALAR_PREFIXES = (
     # governor — the scalar leg the ``overload_shed`` rule watches;
     # the blackbox leg is the "overload" event kind below
     "flow/",
+    # ISSUE 18: bandwidth X-ray counters — per-link bytes/s, bytes/
+    # transition, replay occupancy and checkpoint-epoch sizes as
+    # Perfetto counter tracks on the same clock as spans/alerts
+    "wire/",
+    "ckpt/",
+    "replay/hbm_bytes",
+    "replay/host_bytes",
 )
 
 # blackbox event kinds that mark the *incident* skeleton — rendered
