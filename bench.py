@@ -1562,6 +1562,128 @@ def bench_gateway_ha_overhead(chunks: int = 600, rows: int = 16,
     return {"gateway_ha_overhead": out}
 
 
+def _shard_bench_plane(shards: int, capacity: int = 4096,
+                       fill: int = 2048):
+    """A warmed loopback shard plane: ``fill`` slot-routed rows over
+    ``shards`` in-process shards (capacity split evenly), ready to
+    sample."""
+    from pytorch_distributed_tpu.config import ShardParams
+    from pytorch_distributed_tpu.memory.shard_plane import (
+        build_loopback_plane,
+    )
+    from pytorch_distributed_tpu.utils.experience import (
+        Transition, make_prov,
+    )
+
+    plane, _, registry = build_loopback_plane(
+        ShardParams(shards=shards, lease_s=120.0), capacity=capacity,
+        state_shape=(4,))
+    z = np.zeros(4, dtype=np.float32)
+    for i in range(fill):
+        t = Transition(state0=z, action=np.int32(0),
+                       reward=np.float32(i % 7),
+                       gamma_n=np.float32(0.99), state1=z,
+                       terminal1=np.float32(0.0),
+                       prov=make_prov(i % 8, 0, 0, i))
+        plane.feed(t, float(1.0 + (i % 13)))
+    return plane, registry
+
+
+def bench_shard(samples: int = 400, batch: int = 64,
+                smoke: bool = False) -> dict:
+    """Sharded-replay sample latency vs shard count (ISSUE 20
+    acceptance): the SAME global capacity and fill, sampled through the
+    two-level tree at 1, 2, and 4 in-process (loopback) shards — the
+    1-shard figure is the plane's degenerate case (bit-identical
+    draws to a plain ``PrioritizedReplay``, the tier-1 parity oracle),
+    so the 2/4-shard columns read as the pure cost of the stratified
+    mass routing + per-shard local draws + the |TD| write-back merge.
+    Loopback isolates plane arithmetic from socket noise; the wire
+    path's per-verb cost is ISSUE-18's accountant's to report.
+
+    ``smoke=True`` shrinks the loop to sub-second for CI; the
+    measurement logic is identical."""
+    if smoke:
+        samples = min(samples, 120)
+    out: dict = {"batch": batch, "samples": samples,
+                 "geometry": "smoke-loopback" if smoke else "loopback"}
+    reps = 5  # best-of-reps: scheduler hiccups inflate a mean, not a min
+    chunk = max(1, samples // reps)
+    for n in (1, 2, 4):
+        plane, _ = _shard_bench_plane(n)
+        rng = np.random.default_rng(0)
+        for _ in range(10):  # tree/route warmup
+            b = plane.sample(batch, rng)
+            plane.update_priorities(b.index, np.abs(b.reward) + 0.5)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(chunk):
+                b = plane.sample(batch, rng)
+                plane.update_priorities(b.index, np.abs(b.reward) + 0.5)
+            best = min(best, time.perf_counter() - t0)
+        out[f"sample_ms_{n}shard"] = round(best / chunk * 1e3, 4)
+    print(f"[bench_shard] {out}", file=sys.stderr, flush=True)
+    return {"shard": out}
+
+
+def bench_shard_overhead(samples: int = 400, batch: int = 64,
+                         smoke: bool = False) -> dict:
+    """Shard-plane cost on the sample hot path (ISSUE 20 acceptance):
+    the per-sample span at the production-shaped 4-shard loopback
+    geometry, with the plane's own adds — one forced level-1
+    mass-vector rebuild (the per-sample refresh at the exact-proportions
+    default ``mass_refresh_s=0``) and one cold route rebuild (the
+    every-feed epoch check's worst case) — DIRECTLY timed in isolation.
+    The gate number ``shard_overhead_frac`` is plane-work-per-sample
+    over sample-span, held under the 0.02 absolute band by bench_gate —
+    the PR-10 lesson applies verbatim: differencing two noisy sample
+    rates on a loaded host would read scheduler hiccups as fake
+    overhead, so the rate difference is never the gate number."""
+    plane_iters = 4_000
+    if smoke:
+        samples = min(samples, 120)
+        plane_iters = 1_500
+    plane, _ = _shard_bench_plane(4)
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        b = plane.sample(batch, rng)
+        plane.update_priorities(b.index, np.abs(b.reward) + 0.5)
+    t0 = time.perf_counter()
+    for _ in range(samples):
+        b = plane.sample(batch, rng)
+        plane.update_priorities(b.index, np.abs(b.reward) + 0.5)
+    span = time.perf_counter() - t0
+    # the plane's own work, timed directly: the mass rebuild every
+    # sample pays (poll each live shard + rebuild the level-1 vector)
+    # and the cold route rebuild a membership event would force
+    t0 = time.perf_counter()
+    for _ in range(plane_iters):
+        plane._refresh_mass(force=True)
+    mass_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(plane_iters):
+        plane._route_epoch = -1
+        plane._refresh_route()
+    route_s = time.perf_counter() - t0
+    per_sample = span / max(samples, 1)
+    per_mass = mass_s / max(plane_iters, 1)
+    per_route = route_s / max(plane_iters, 1)
+    out = {
+        "sample_ms": round(per_sample * 1e3, 4),
+        "mass_refresh_us": round(per_mass * 1e6, 3),
+        "route_rebuild_us": round(per_route * 1e6, 3),
+        # the gate number: per-sample plane work (mass rebuild + cold
+        # route rebuild, the conservative bound) / per-sample span
+        "shard_overhead_frac": round(
+            (per_mass + per_route) / per_sample, 4),
+        "shards": 4,
+        "geometry": "smoke-loopback" if smoke else "loopback",
+    }
+    print(f"[bench_shard_overhead] {out}", file=sys.stderr, flush=True)
+    return {"shard_overhead": out}
+
+
 def bench_wire(rows: int = 400, chunk_rows: int = 25,
                grad_dim: int = 65536, smoke: bool = False) -> dict:
     """Wire byte economics (ISSUE 18): the bandwidth X-ray's measured
@@ -2489,7 +2611,7 @@ def main() -> None:
                                        "health", "perf", "device_env",
                                        "provenance", "metrics", "flow",
                                        "anakin", "replica",
-                                       "gateway", "wire"),
+                                       "gateway", "wire", "shard"),
                     default="both")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale CPU-safe bench (the dqn-mlp "
@@ -2550,6 +2672,12 @@ def main() -> None:
         # stage 2e fails on their absence
         result.update(bench_wire(smoke=True))
         result.update(bench_wire_overhead(smoke=True))
+        # ISSUE-20 sharded-replay plane: sample latency at 1/2/4
+        # loopback shards and the mass-refresh+route cost vs the
+        # sample span: additive keys, schema stays 4; tools/check.sh
+        # stage 2f fails on their absence
+        result.update(bench_shard(smoke=True))
+        result.update(bench_shard_overhead(smoke=True))
         # ISSUE-12 co-located loop: the closed rollout+learn pair rate
         # on a tiny fleet (additive key, schema stays 4; the full
         # section with the split-process comparison runs under --mode
@@ -2594,6 +2722,9 @@ def main() -> None:
     if args.mode in ("both", "wire"):
         result.update(bench_wire())
         result.update(bench_wire_overhead())
+    if args.mode in ("both", "shard"):
+        result.update(bench_shard())
+        result.update(bench_shard_overhead())
     if args.mode in ("both", "actor"):
         result.update(bench_actor_pipeline(args.actor_envs,
                                            args.actor_ticks))

@@ -131,6 +131,10 @@ KNOBS = (
      "per-field BandwidthParams overrides — the ISSUE-18 byte-exact "
      "wire/ring/checkpoint accountant (e.g. TPU_APEX_WIRE_SPAWN, "
      "TPU_APEX_WIRE_RATE_FLOOR_S)"),
+    ("TPU_APEX_SHARD_*", "memory/shard_plane.py",
+     "per-field ShardParams overrides — the ISSUE-20 sharded "
+     "prioritized-replay plane (e.g. TPU_APEX_SHARD_SHARDS, "
+     "TPU_APEX_SHARD_LEASE_S, TPU_APEX_SHARD_COORDINATOR)"),
 )
 
 
@@ -795,6 +799,55 @@ class GatewayParams:
 
 
 @dataclass
+class ShardParams:
+    """Sharded prioritized-replay plane knobs (ISSUE 20;
+    memory/shard_plane.py ShardRegistry / ShardedReplayPlane — no
+    reference equivalent: the reference's replay is one host's shared
+    pages, full stop).  Every field is env-overridable as
+    ``TPU_APEX_SHARD_<FIELD>`` via ``memory.shard_plane.resolve_shard``,
+    the same spawn-inheritance contract the health/perf/flow/replica
+    planes use.
+
+    The INES topology (PAPERS.md): each gateway host owns a replay ring
+    SHARD with its own local sum/min trees, and the learner samples
+    through a two-level tree — a global priority-mass vector over
+    shards routes stratified sample values to the shard that owns the
+    mass stratum, which answers locally (sample where experience lands,
+    never ship raw transitions twice).  Shard membership is lease-fenced
+    with monotonic generations (the PR-14 replica contract): a shard
+    that misses its lease window is expired, its priority mass leaves
+    the global vector, its transitions are counted into the
+    ``shard_lost`` ledger bucket (conservation stays EXACT through the
+    loss), and any |TD| write-back stamped with its dead generation is
+    a counted reject — never applied.  At ``shards <= 1`` the plane is
+    off: the single-host PER path runs bit-identically, no registry,
+    no verbs, no STATUS block."""
+
+    # Configured shard count (<= 1 = plane off: build_memory constructs
+    # the plain single-host PrioritizedReplay exactly as before).  The
+    # plane is elastic below this: fewer live shards is a DEGRADED
+    # (alerted) state, not an error.
+    shards: int = 0
+    # Lease window, seconds: a shard host that neither renews (renews
+    # carry its mass/fill/ingest report) nor serves within it is
+    # expired and fenced.
+    lease_s: float = 5.0
+    # Background renew cadence, seconds (0 = lease_s / 3).
+    renew_s: float = 0.0
+    # Global mass-vector refresh cadence on the sample path, seconds
+    # (0 = refresh at EVERY sample — exact priority proportions, the
+    # loopback/tier-1 default; wire fleets trade a bounded staleness
+    # window for fewer T_SMASS round-trips by raising this).
+    mass_refresh_s: float = 0.0
+    # Seconds a rejoining shard may take to re-lease, warm its ring,
+    # and activate at the rejoin barrier before the join is cancelled.
+    join_timeout_s: float = 30.0
+    # Coordinator gateway ``host:port`` a remote shard host dials
+    # (fleet.py --role replay-shard --coordinator).
+    coordinator: str = ""
+
+
+@dataclass
 class LearnerPerfParams:
     """MFU-campaign knobs (ISSUE 13; no reference equivalent — the
     reference never measures device utilization at all).  Every field
@@ -917,6 +970,7 @@ class Options:
         default_factory=LearnerPerfParams)
     replica_params: ReplicaParams = field(default_factory=ReplicaParams)
     gateway_params: GatewayParams = field(default_factory=GatewayParams)
+    shard_params: ShardParams = field(default_factory=ShardParams)
 
     @property
     def model_dir(self) -> str:
@@ -1012,7 +1066,7 @@ def build_options(config: int = 1, **overrides: Any) -> Options:
                     "perf_params", "metrics_params", "alert_params",
                     "flow_params", "anakin_params",
                     "learner_perf_params", "replica_params",
-                    "gateway_params"):
+                    "gateway_params", "shard_params"):
             subobj = getattr(opt, sub)
             if hasattr(subobj, key):
                 hits.append((sub, subobj))

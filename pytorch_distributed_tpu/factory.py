@@ -912,6 +912,29 @@ def build_memory(opt: Options, spec: EnvSpec) -> MemoryHandles:
         )
         return MemoryHandles(actor_side=mem, learner_side=mem)
     if opt.memory_type == "prioritized":
+        from pytorch_distributed_tpu.memory import shard_plane
+
+        if shard_plane.sharding_active(opt.shard_params):
+            # ISSUE 20: the sharded priority plane — N loopback shards
+            # behind the SAME QueueOwner boundary, so the learner loop,
+            # feeder, and quarantine path never learn sharding exists.
+            # At shards <= 1 this branch is never taken and the plain
+            # PER below is constructed bit-identically to every prior
+            # release.
+            plane, _shards, _reg = shard_plane.build_loopback_plane(
+                opt.shard_params,
+                capacity=mp_.memory_size,
+                state_shape=spec.state_shape,
+                action_shape=spec.action_shape,
+                state_dtype=state_dtype,
+                action_dtype=spec.action_dtype,
+                priority_exponent=mp_.priority_exponent,
+                importance_weight=mp_.priority_weight,
+                importance_anneal_steps=opt.agent_params.steps,
+            )
+            owner = QueueOwner(plane)
+            return MemoryHandles(actor_side=owner.make_feeder(),
+                                 learner_side=owner)
         per = PrioritizedReplay(
             capacity=mp_.memory_size,
             state_shape=spec.state_shape,

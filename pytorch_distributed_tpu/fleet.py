@@ -513,6 +513,126 @@ def run_gateway_standby(opt: Options, coordinator: str,
               f"(spooled {spooled[0]} rows post-promotion)")
 
 
+def run_replay_shard_host(opt: Options, coordinator: str,
+                          shard_id: int, port: int = 0) -> None:
+    """``--role replay-shard``: one replay ring shard of the sharded
+    priority plane (ISSUE 20, memory/shard_plane.py).  The host owns a
+    whole ``PrioritizedReplay`` and serves the two-level sample's
+    shard-local leg over T_SSAMPLE/T_SPRIO on its own gateway; actors
+    stream T_EXP chunks AT this host (experience samples where it
+    LANDS — the INES topology), and every ingest ack renews the shard's
+    coordinator lease with the updated cumulative ingest report, so the
+    registry's conservation ledger is exact at every chunk boundary: a
+    crash loses only unacked — hence actor-counted — rows.
+
+    A restarted shard id re-leases at a fresh generation in ``joining``
+    (routed ingest, no sample mass) and activates once its ring is
+    warm — the rejoin barrier.  SIGTERM releases the lease (rows move
+    to the ``shard_lost`` bucket, counted) and exits 0."""
+    import numpy as np
+
+    from pytorch_distributed_tpu.factory import probe_env
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.memory.prioritized import (
+        PrioritizedReplay,
+    )
+    from pytorch_distributed_tpu.memory.shard_plane import (
+        LocalShard, ShardLease, resolve_shard,
+    )
+    from pytorch_distributed_tpu.parallel.dcn import (
+        DcnGateway, parse_endpoints,
+    )
+    from pytorch_distributed_tpu.utils import flight_recorder
+
+    sp = resolve_shard(opt.shard_params)
+    if sp.shards <= 1:
+        raise SystemExit(
+            "--role replay-shard needs the shard plane on: set "
+            "TPU_APEX_SHARD_SHARDS >= 2 (or opt.shard_params.shards)")
+    flight_recorder.configure(opt.log_dir, run_id=opt.refs)
+    spec = probe_env(opt)
+    mp_ = opt.memory_params
+    state_dtype = np.uint8 if mp_.state_dtype == "uint8" else np.float32
+    shard_capacity = max(1, -(-int(mp_.memory_size) // sp.shards))
+    shard = LocalShard(shard_id, PrioritizedReplay(
+        capacity=shard_capacity,
+        state_shape=spec.state_shape,
+        action_shape=spec.action_shape,
+        state_dtype=state_dtype,
+        action_dtype=spec.action_dtype,
+        priority_exponent=mp_.priority_exponent,
+        importance_weight=mp_.priority_weight,
+        importance_anneal_steps=opt.agent_params.steps))
+    lease = ShardLease(
+        parse_endpoints(coordinator or sp.coordinator)[0],
+        shard_id, incarnation=int(time.time() * 1000) & 0x7FFFFFFF,
+        capacity=shard_capacity)
+    lease.acquire()
+    shard.generation = lease.generation
+
+    def _report() -> dict:
+        rep = shard.mass()
+        rep["mass"] = rep["total"]
+        rep["fill"] = (rep["size"] / shard_capacity
+                       if shard_capacity else 0.0)
+        return rep
+
+    def _ingest(items: list) -> None:
+        # renew-WITH-updated-ingest before the gateway acks the chunk:
+        # the registry ledger moves in the same step the rows become
+        # ours, so a crash between acks is exactly the unacked chunk
+        for tr, pr in items:
+            shard.feed(tr, pr)
+        if lease.joining and shard.ingested_rows > 0:
+            lease.activate()  # ring is warm: cross the rejoin barrier
+        lease.renew(_report())
+
+    gw = DcnGateway(ParamStore(4), GlobalClock(), ActorStats(),
+                    put_chunk=_ingest, port=port, shards=shard)
+    host_stop = threading.Event()
+    if threading.current_thread() is threading.main_thread():
+        try:
+            signal.signal(signal.SIGTERM, lambda s, f: host_stop.set())
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+    renew_s = sp.renew_s if sp.renew_s > 0 else max(0.05, sp.lease_s / 3)
+    print(f"[fleet] replay shard {shard_id} up on port {gw.port} "
+          f"(generation {lease.generation}, capacity {shard_capacity}, "
+          f"{'joining' if lease.joining else 'member'}, lease "
+          f"{sp.lease_s:g}s)")
+    try:
+        while not host_stop.is_set():
+            if host_stop.wait(renew_s):
+                break
+            try:
+                if lease.joining and shard.ingested_rows > 0:
+                    lease.activate()
+                if not lease.renew(_report()):
+                    # expired under us (partition outlived the lease):
+                    # re-lease at a fresh generation and rejoin
+                    lease.acquire()
+                    shard.generation = lease.generation
+                    print(f"[fleet] shard {shard_id} lease expired; "
+                          f"rejoined at generation {lease.generation} "
+                          f"(joining={lease.joining})", flush=True)
+            except (ConnectionError, OSError) as e:
+                print(f"[fleet] shard {shard_id} coordinator "
+                      f"unreachable: {e!r}", flush=True)
+    finally:
+        shard.alive = False  # drain: answer SSTAT_DEAD, never silence
+        try:
+            lease.release()
+        except (ConnectionError, OSError):
+            pass
+        gw.close()
+        print(f"[fleet] replay shard {shard_id} exiting: "
+              f"{shard.ingested_rows} rows ingested, "
+              f"{shard.stale_rejected} stale write-backs rejected")
+
+
 # ---------------------------------------------------------------------------
 # actor host
 # ---------------------------------------------------------------------------
@@ -846,12 +966,16 @@ def main(argv: Optional[List[str]] = None) -> None:
         description="multi-host Ape-X fleet launcher")
     ap.add_argument("--role",
                     choices=("learner", "actors", "learner-replica",
-                             "gateway-standby"),
+                             "gateway-standby", "replay-shard"),
                     required=True)
     ap.add_argument("--replica-id", type=int, default=1,
                     help="[learner-replica] this host's replica id "
                          "(replica 0 is the lead learner host; ids "
                          "must be unique across the fleet)")
+    ap.add_argument("--shard-id", type=int, default=0,
+                    help="[replay-shard] this host's replay shard id "
+                         "(ids must be unique across the fleet; "
+                         "ISSUE 20, memory/shard_plane.py)")
     ap.add_argument("--config", type=int, default=1)
     ap.add_argument("--num-actors", type=int, default=None,
                     help="TOTAL fleet actor count (defaults to config)")
@@ -951,6 +1075,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     elif args.role == "gateway-standby":
         assert args.coordinator, "--coordinator host:port required"
         run_gateway_standby(opt, args.coordinator, args.port)
+    elif args.role == "replay-shard":
+        assert args.coordinator, "--coordinator host:port required"
+        run_replay_shard_host(opt, args.coordinator, args.shard_id,
+                              args.port)
     else:
         assert args.coordinator, "--coordinator host:port required"
         abandoned = run_fleet_actors(opt, args.coordinator, args.actor_base,

@@ -187,6 +187,25 @@ T_SYNC = 15     # JSON {since} -> JSON {term, seq, base_seq, records,
 #                replies with an ``error`` key — the verb is never sent
 #                unless the HA plane is on, keeping the pre-HA wire
 #                byte-identical.
+# --- sharded-replay verbs (ISSUE 20): sessionless-adjacent like the
+# replica verbs, OUTSIDE the wire fault plane (the shard fault plane is
+# lease expiry + generation fencing in memory/shard_plane.py — a
+# kill/hang of the shard HOST is the real failure mode).  None of these
+# frames is ever sent unless ShardParams.shards > 1, keeping the
+# pre-shard wire byte-identical.  All codecs live in shard_plane.py;
+# the gateway dispatches to duck-typed ``handle_*`` methods on its
+# ``shards=`` object (a LocalShard on shard hosts, a ShardRegistry on
+# the coordinator) so this module never imports the plane.
+T_SSAMPLE = 16  # savez {meta=[shard, generation], values?} -> savez
+#                mass report (+ sampled rows when values were sent):
+#                the two-level sample's shard-local leg; empty values
+#                doubles as the level-1 mass poll
+T_SMASS = 17    # JSON shard membership verbs against the coordinator's
+#                ShardRegistry (acquire/renew/release/activate/status)
+#                or a mass poll against a shard host
+T_SPRIO = 18    # savez {meta=[shard, generation], pidx, ptd} -> JSON
+#                reply; stale-generation write-backs are counted
+#                rejects (the T_RPRIO contract on the shard plane)
 
 _MAX_FRAME = 1 << 31  # 2 GiB — far above any chunk; rejects garbage lengths
 
@@ -197,7 +216,8 @@ bandwidth.register_verbs({
     T_CLOCK: "clock", T_TICK: "tick", T_BYE: "bye", T_PING: "ping",
     T_STATUS: "status", T_PROFILE: "profile", T_METRICS: "metrics",
     T_RLEASE: "rlease", T_RGRAD: "rgrad", T_RPRIO: "rprio",
-    T_SYNC: "sync",
+    T_SYNC: "sync", T_SSAMPLE: "ssample", T_SMASS: "smass",
+    T_SPRIO: "sprio",
 })
 
 
@@ -822,6 +842,18 @@ def _pack_prio(replica: int, generation: int, pidx: np.ndarray,
              meta=np.asarray([replica, generation], np.int64),
              pidx=np.ascontiguousarray(pidx, dtype=np.int32),
              ptd=np.ascontiguousarray(ptd, dtype=np.float32))
+    return out.getvalue()
+
+
+def _pack_noshard_reply() -> bytes:
+    """The ONE shard-plane frame this module authors: an SSTAT_NOSHARD
+    T_SSAMPLE reply (memory/shard_plane.py owns every other codec and
+    the status vocabulary; 3 == shard_plane.SSTAT_NOSHARD — its test
+    pins the pair so they cannot drift) for gateways with no ``shards=``
+    handler wired."""
+    out = io.BytesIO()
+    np.savez(out, status=np.asarray([3], np.int64),
+             generation=np.asarray([0], np.int64))
     return out.getvalue()
 
 
@@ -1769,6 +1801,7 @@ class DcnGateway:
                  pressure: Optional[Callable[[], float]] = None,
                  flow_writer=None,
                  replicas: Optional[ReplicaRegistry] = None,
+                 shards=None,
                  gateway_params=None,
                  log_dir: Optional[str] = None,
                  ha_role: str = "primary",
@@ -1806,6 +1839,13 @@ class DcnGateway:
         # replicas.  None on non-replicated fleets — the verbs then
         # answer counted errors, never crash a serve thread.
         self._replicas = replicas
+        # shard plane (ISSUE 20): duck-typed handler for the shard
+        # verbs — a memory.shard_plane.LocalShard on replay-shard
+        # hosts, a ShardRegistry on the coordinator.  Duck-typed so
+        # this module never imports the plane; None on unsharded
+        # fleets — the verbs then answer counted errors, never crash
+        # a serve thread, and STATUS carries no shards block at all.
+        self._shards = shards
         self._tracer = tracing.get_tracer("gateway")
         self._recorder = flight_recorder.get_recorder("gateway")
         # flow-control plane (ISSUE 11, utils/flow.py): per-slot credit
@@ -2008,7 +2048,8 @@ class DcnGateway:
         a fenced stale-term gateway's writes/grants are counted rejects
         that are NEVER applied."""
         if ftype in (T_STATUS, T_PROFILE, T_METRICS, T_RLEASE,
-                     T_RGRAD, T_RPRIO, T_SYNC, T_BYE):
+                     T_RGRAD, T_RPRIO, T_SYNC, T_SSAMPLE, T_SMASS,
+                     T_SPRIO, T_BYE):
             return
         if not self._serving:
             self.standby_refused += 1
@@ -2301,6 +2342,16 @@ class DcnGateway:
             # + the fencing ledger — fleet_top's ``replicas:`` panel
             # line and the chaos drills' exact-counter verdicts
             snap["replicas"] = self._replicas.status_block()
+        if self._shards is not None and hasattr(self._shards,
+                                                "status_block"):
+            # shard plane (ISSUE 20): membership/mass-share/lease ages
+            # + the degradation ledger — fleet_top's ``shards:`` panel
+            # line and the shard drills' exact-counter verdicts.  Only
+            # the coordinator's registry has a status_block; shard
+            # HOSTS (a LocalShard handler) report through their lease
+            # renews instead.  Absent with sharding off: unsharded
+            # peers observe zero new fields anywhere.
+            snap["shards"] = self._shards.status_block()
         if self._ha:
             # gateway HA plane (ISSUE 16): role/term/sync lag + the
             # failover ledger — fleet_top's ``gateway:`` panel line and
@@ -2469,7 +2520,8 @@ class DcnGateway:
                         # verbs BEFORE any of their side effects
                         self._session_gate(ftype)
                     if ftype not in (T_STATUS, T_PROFILE, T_METRICS,
-                                     T_RLEASE, T_RGRAD, T_RPRIO, T_SYNC):
+                                     T_RLEASE, T_RGRAD, T_RPRIO, T_SYNC,
+                                     T_SSAMPLE, T_SMASS, T_SPRIO):
                         # STATUS/PROFILE/METRICS probes and the replica
                         # plane are outside the wire fault plane: a
                         # monitor polling the gateway must neither shift
@@ -2588,6 +2640,50 @@ class DcnGateway:
                         else:
                             reply = self._replicas.handle_prio(payload)
                         _send_frame(conn, T_RPRIO,
+                                    json.dumps(reply).encode())
+                    elif ftype == T_SSAMPLE:
+                        # shard-local sample leg of the two-level draw
+                        # (ISSUE 20), sessionless-adjacent like the
+                        # replica verbs; the codec and the generation
+                        # fence live in memory/shard_plane.py — the
+                        # handler object owns both sides of the frame
+                        if self._shards is None or not hasattr(
+                                self._shards, "handle_ssample"):
+                            _send_frame(conn, T_SSAMPLE,
+                                        _pack_noshard_reply())
+                        else:
+                            _send_frame(conn, T_SSAMPLE,
+                                        self._shards.handle_ssample(
+                                            payload))
+                    elif ftype == T_SMASS:
+                        # shard membership verbs (coordinator) or the
+                        # mass poll (shard host) — plain JSON either way
+                        msg = self._json(payload) if payload else {}
+                        if self._shards is None:
+                            reply = {"status": "error",
+                                     "error": "no shard plane wired "
+                                              "on this gateway"}
+                        else:
+                            try:
+                                reply = self._shards.handle_smass(msg)
+                            except Exception as e:  # noqa: BLE001
+                                reply = {"status": "error",
+                                         "error": f"shard plane "
+                                                  f"failed: {e!r}"}
+                        _send_frame(conn, T_SMASS,
+                                    json.dumps(reply).encode())
+                    elif ftype == T_SPRIO:
+                        # cross-shard |TD| write-back with
+                        # last-generation-wins fencing (a zombie
+                        # learner's writes die HERE, counted)
+                        if self._shards is None or not hasattr(
+                                self._shards, "handle_sprio"):
+                            reply = {"status": "error",
+                                     "error": "no shard plane wired "
+                                              "on this gateway"}
+                        else:
+                            reply = self._shards.handle_sprio(payload)
+                        _send_frame(conn, T_SPRIO,
                                     json.dumps(reply).encode())
                     elif ftype == T_SYNC:
                         # gateway HA control-plane pull (ISSUE 16),

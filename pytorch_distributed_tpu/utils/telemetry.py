@@ -458,6 +458,15 @@ DEFAULT_RULES = (
     # silently inert there (threshold rules never fire on a series
     # that was never written)
     "gateway_failover: gateway/sync_stale >= 1 for 60s",
+    # sharded replay plane (ISSUE 20): the shard registry writes
+    # ``replay/shard_degraded`` as an explicit 0/1 on every lease event
+    # and renew — 1 whenever live membership is below the configured
+    # shard count.  Threshold-with-dwell so one lease-window blip never
+    # pages, and the rule RESOLVES once a rejoin restores membership
+    # (the registry keeps reporting 0).  Unsharded fleets never
+    # construct a registry, so the tag is never written and the rule
+    # stays silently inert there.
+    "shard_membership: replay/shard_degraded >= 1 for 60s",
 )
 
 
@@ -908,6 +917,7 @@ class MissionControl:
                 "replay/priority_ess_frac", "flow/overload_state",
                 "anakin/duty_cycle", "anakin/replay_fill",
                 "replica/members", "replica/generation_churn",
+                "replay/shard_members", "replay/shard_mass_skew",
                 "learner/critic_loss", "evaluator/avg_reward",
                 "actor/avg_reward", "learner/steps_per_sec")
 

@@ -1,0 +1,581 @@
+"""Tier-1 oracles for the sharded prioritized-replay plane (ISSUE 20).
+
+The trust anchor is bit-parity: a 1-shard plane must be BIT-identical
+to the single-host ``PrioritizedReplay`` path (sampled indices, IS
+weights, |TD| write-backs, priorities) — the PR-14 N=1 oracle pattern
+on the replay plane — and a kill-at-round-K plane must sample exactly
+like a fresh plane built from the surviving shards only.  The rest is
+the fault ledger: lease expiry within one window, exact conservation
+through the loss (shard_lost + route_dropped counted), fenced stale
+write-backs (counted, never applied), and the rejoin barrier
+(ingest-first, sample-after-activate)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import ShardParams
+from pytorch_distributed_tpu.memory.prioritized import PrioritizedReplay
+from pytorch_distributed_tpu.memory.shard_plane import (
+    SSTAT_DEAD, SSTAT_OK, LocalShard, LoopbackShardChannel,
+    ShardRegistry, _pack_sprio, _pack_ssample, _pack_ssample_reply,
+    _unpack_ssample, _unpack_ssample_reply, build_loopback_plane,
+    resolve_shard, sharding_active,
+)
+from pytorch_distributed_tpu.utils.experience import (
+    REPLAY_FIELDS, Transition, make_prov,
+)
+
+GEOM = dict(state_shape=(4,), state_dtype=np.float32,
+            action_shape=(), action_dtype=np.int32)
+
+
+def _tr(i, actor=None):
+    prov = (make_prov(actor, i % 8, 0, i) if actor is not None else None)
+    return Transition(
+        state0=np.full((4,), i, dtype=np.float32),
+        action=np.int32(i % 4),
+        reward=np.float32(i),
+        gamma_n=np.float32(0.99),
+        state1=np.full((4,), i + 1, dtype=np.float32),
+        terminal1=np.float32(i % 7 == 0),
+        prov=prov)
+
+
+def _plane(shards, capacity, lease_s=30.0, **kw):
+    return build_loopback_plane(
+        ShardParams(shards=shards, lease_s=lease_s),
+        capacity=capacity, priority_exponent=0.6,
+        importance_weight=0.4, importance_anneal_steps=50,
+        **GEOM, **kw)
+
+
+def _expire(reg, plane, sid, rng, timeout=5.0):
+    """Drive sampling until the dead shard's lease expires (survivor
+    polls renew their own leases; the dead one goes silent)."""
+    deadline = time.monotonic() + timeout
+    while any(m["shard"] == sid
+              for m in reg.live_members(include_joining=True)):
+        plane.sample(4, rng)
+        assert time.monotonic() < deadline, \
+            f"shard {sid} never expired within {timeout}s"
+        time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# the bit-parity oracles
+# ---------------------------------------------------------------------------
+
+class TestOneShardParity:
+    def test_bit_identical_to_single_host_per(self):
+        per = PrioritizedReplay(
+            capacity=64, priority_exponent=0.6, importance_weight=0.4,
+            importance_anneal_steps=50, **GEOM)
+        plane, shards, reg = _plane(1, 64)
+        assert plane.shard_capacity == 64  # 1 shard owns the full budget
+        for i in range(40):
+            pr = None if i % 3 == 0 else float(i % 5) + 0.5
+            per.feed(_tr(i, actor=i % 3), pr)
+            plane.feed(_tr(i, actor=i % 3), pr)
+        rng_a = np.random.default_rng(7)
+        rng_b = np.random.default_rng(7)
+        for rnd in range(6):
+            ba = per.sample(16, rng_a)
+            bb = plane.sample(16, rng_b)
+            # indices, IS weights, and every replay column: BIT-equal
+            np.testing.assert_array_equal(ba.index, bb.index)
+            assert ba.index.dtype == bb.index.dtype == np.int32
+            np.testing.assert_array_equal(ba.weight, bb.weight)
+            assert bb.weight.dtype == np.float32
+            for f in REPLAY_FIELDS:
+                np.testing.assert_array_equal(
+                    getattr(ba, f), getattr(bb, f))
+            # provenance of the sampled rows matches the single-host read
+            np.testing.assert_array_equal(
+                per.provenance_of(ba.index),
+                plane.provenance_of(bb.index))
+            # |TD| write-back rides the same math (incl. max_priority,
+            # exercised by the None-priority feeds above)
+            td = (np.sin(np.arange(16) + rnd) * 3.0).astype(np.float32)
+            per.update_priorities(ba.index, td)
+            plane.update_priorities(bb.index, td)
+        np.testing.assert_array_equal(per.priority_leaves(),
+                                      plane.priority_leaves())
+        # nothing was fenced on the healthy path
+        assert reg.stale_writeback_rejected == 0
+        assert reg.route_dropped == 0
+
+    def test_write_back_then_resample_stays_identical(self):
+        per = PrioritizedReplay(
+            capacity=32, priority_exponent=0.6, importance_weight=0.4,
+            importance_anneal_steps=50, **GEOM)
+        plane, _, _ = _plane(1, 32)
+        for i in range(20):
+            per.feed(_tr(i))
+            plane.feed(_tr(i))
+        rng_a, rng_b = (np.random.default_rng(3),
+                        np.random.default_rng(3))
+        ba, bb = per.sample(8, rng_a), plane.sample(8, rng_b)
+        per.update_priorities(ba.index, np.zeros(8))
+        plane.update_priorities(bb.index, np.zeros(8))
+        ba, bb = per.sample(8, rng_a), plane.sample(8, rng_b)
+        np.testing.assert_array_equal(ba.index, bb.index)
+        np.testing.assert_array_equal(ba.weight, bb.weight)
+
+
+class TestKillAtRoundK:
+    def test_survivors_match_fresh_survivor_plane(self):
+        plane, shards, reg = _plane(3, 96)
+        for i in range(60):
+            plane.feed(_tr(i, actor=i))
+        rng = np.random.default_rng(5)
+        for rnd in range(4):
+            b = plane.sample(8, rng)
+            plane.update_priorities(
+                b.index, np.cos(np.arange(8) + rnd) * 2.0)
+        # kill shard 1 mid-life: the mass vector drops it on the next
+        # refresh, before the lease even expires
+        shards[1].alive = False
+        # oracle: a FRESH plane built from the survivors' snapshots
+        fresh_plane, fresh_shards, _ = _plane(3, 96, shard_ids=[0, 2])
+        fresh_shards[0].restore(shards[0].snapshot())
+        fresh_shards[2].restore(shards[2].snapshot())
+        fresh_plane._samples_drawn = plane._samples_drawn
+        rng_a = np.random.default_rng(11)
+        rng_b = np.random.default_rng(11)
+        for _ in range(3):
+            ba = plane.sample(8, rng_a)
+            bb = fresh_plane.sample(8, rng_b)
+            np.testing.assert_array_equal(ba.index, bb.index)
+            np.testing.assert_array_equal(ba.weight, bb.weight)
+            for f in REPLAY_FIELDS:
+                np.testing.assert_array_equal(
+                    getattr(ba, f), getattr(bb, f))
+        # no survivor row decodes into the dead shard's id range
+        assert not np.any((ba.index >= plane.shard_capacity)
+                          & (ba.index < 2 * plane.shard_capacity))
+
+
+# ---------------------------------------------------------------------------
+# the fault ledger
+# ---------------------------------------------------------------------------
+
+class TestShardLoss:
+    def test_lease_expiry_keeps_conservation_exact(self):
+        plane, shards, reg = _plane(2, 32, lease_s=0.05)
+        minted = 0
+        for i in range(20):
+            plane.feed(_tr(i))
+            minted += 1
+        assert shards[0].ingested_rows == shards[1].ingested_rows == 10
+        shards[1].alive = False
+        # rows routed at the dead-but-unexpired shard are counted drops
+        for i in range(4):
+            plane.feed(_tr(100 + i))
+            minted += 1
+        led = reg.ledger()
+        assert (led["ingested"] + led["shard_lost"]
+                + led["route_dropped"]) == minted
+        rng = np.random.default_rng(1)
+        _expire(reg, plane, 1, rng)
+        assert reg.leases_expired == 1
+        assert reg.shard_lost_rows == 10  # the dead shard's acked rows
+        led = reg.ledger()
+        assert (led["ingested"] + led["shard_lost"]
+                + led["route_dropped"]) == minted
+        # post-loss ingest drains onto the survivor, ledger still exact
+        for i in range(6):
+            plane.feed(_tr(200 + i))
+            minted += 1
+        led = reg.ledger()
+        assert (led["ingested"] + led["shard_lost"]
+                + led["route_dropped"]) == minted
+        sb = reg.status_block()
+        assert sb["degraded"] is True
+        assert sb["counters"]["shard_lost_rows"] == 10
+        # and sampling still answers (over the survivor alone)
+        b = plane.sample(8, rng)
+        assert np.all(b.index < plane.shard_capacity)
+
+    def test_stale_writeback_is_counted_never_applied(self):
+        plane, shards, reg = _plane(2, 32, lease_s=0.05)
+        for i in range(16):
+            plane.feed(_tr(i))
+        rng = np.random.default_rng(2)
+        b = plane.sample(32, rng)
+        dead_rows = int(np.sum(b.index >= plane.shard_capacity))
+        assert dead_rows > 0  # the batch straddles both shards
+        shards[1].alive = False
+        leaves_before = shards[1].per.priority_leaves().copy()
+        _expire(reg, plane, 1, rng)
+        plane.update_priorities(b.index, np.full(32, 9.9, np.float32))
+        # the dead shard's rows were fenced at the registry: counted,
+        # and its tree is untouched
+        assert reg.stale_writeback_rejected == dead_rows
+        np.testing.assert_array_equal(
+            shards[1].per.priority_leaves(), leaves_before)
+        # the survivor's rows DID apply
+        applied = shards[0].per.sum_tree.get(
+            (b.index[b.index < plane.shard_capacity]).astype(np.int64))
+        np.testing.assert_allclose(
+            applied, (9.9 + 1e-6) ** 0.6, rtol=1e-6)
+
+    def test_zombie_generation_rejected_at_the_shard(self):
+        plane, shards, reg = _plane(2, 32)
+        for i in range(8):
+            plane.feed(_tr(i))
+        leaves = shards[0].per.priority_leaves().copy()
+        ok = shards[0].write_prio(np.array([0, 1]),
+                                  np.array([5.0, 5.0]), generation=999)
+        assert ok is False
+        assert shards[0].stale_rejected == 2
+        np.testing.assert_array_equal(
+            shards[0].per.priority_leaves(), leaves)
+
+    def test_double_lease_newer_incarnation_fences(self):
+        reg = ShardRegistry(ShardParams(shards=2, lease_s=30.0))
+        g1 = reg.acquire(0, incarnation=1)
+        assert g1["status"] == "ok"
+        reg.renew(0, g1["generation"], {"ingested": 7})
+        # equal incarnation: refused (the holder is still live)
+        assert reg.acquire(0, incarnation=1)["status"] == "refused"
+        # newer incarnation: evicts + fences the half-open predecessor
+        g2 = reg.acquire(0, incarnation=2)
+        assert g2["status"] == "ok"
+        assert g2["generation"] > g1["generation"]
+        assert reg.lease_fenced == 1
+        assert reg.shard_lost_rows == 7
+        assert reg.renew(0, g1["generation"])["status"] == "expired"
+
+
+class TestRejoinBarrier:
+    def test_joining_gets_ingest_but_no_sample_mass(self):
+        plane, shards, reg = _plane(2, 32, lease_s=0.05)
+        for i in range(12):
+            plane.feed(_tr(i))
+        rng = np.random.default_rng(4)
+        shards[1].alive = False
+        _expire(reg, plane, 1, rng)
+        # rejoin at a fresh generation: joining (the epoch barrier)
+        per2 = PrioritizedReplay(
+            capacity=plane.shard_capacity, priority_exponent=0.6,
+            importance_weight=0.4, importance_anneal_steps=50, **GEOM)
+        ns = LocalShard(1, per2)
+        grant = reg.acquire(1, incarnation=2,
+                            capacity=plane.shard_capacity)
+        assert grant["status"] == "ok" and grant["joining"] is True
+        ns.generation = int(grant["generation"])
+        plane.attach_channel(1, LoopbackShardChannel(ns, reg))
+        # membership resolved: no longer degraded (the alert clears)
+        assert reg.status_block()["degraded"] is False
+        # ingest routes to the joiner immediately (rebalance)...
+        for i in range(8):
+            plane.feed(_tr(300 + i))
+        assert ns.ingested_rows > 0
+        # ...but sampling excludes it until activate
+        b = plane.sample(16, rng)
+        assert np.all(b.index < plane.shard_capacity)
+        assert reg.activate(1, ns.generation)["status"] == "ok"
+        assert reg.joins_completed == 1
+        b = plane.sample(64, rng)
+        assert np.any(b.index >= plane.shard_capacity)
+
+    def test_fresh_shard_is_a_full_member_at_once(self):
+        reg = ShardRegistry(ShardParams(shards=2, lease_s=30.0))
+        g = reg.acquire(0, incarnation=1)
+        assert g["joining"] is False
+
+    def test_join_timeout_cancels_the_ghost(self):
+        reg = ShardRegistry(ShardParams(shards=2, lease_s=0.05,
+                                        join_timeout_s=0.05))
+        g1 = reg.acquire(0, incarnation=1)
+        # expire it, then rejoin and never activate
+        time.sleep(0.12)
+        assert reg.live_members(include_joining=True) == []
+        g2 = reg.acquire(0, incarnation=2)
+        assert g2["joining"] is True
+        deadline = time.monotonic() + 5.0
+        while any(m["shard"] == 0
+                  for m in reg.live_members(include_joining=True)):
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert reg.joins_timed_out == 1
+
+
+class TestRebalance:
+    def test_route_rebuilds_on_membership_change(self):
+        plane, shards, reg = _plane(2, 32, lease_s=0.05)
+        for i in range(8):
+            plane.feed(_tr(i))
+        epoch0 = reg.route_epoch
+        rebal0 = reg.rebalances
+        shards[1].alive = False
+        rng = np.random.default_rng(6)
+        _expire(reg, plane, 1, rng)
+        assert reg.route_epoch > epoch0
+        assert reg.rebalances > rebal0
+        # every post-change row lands on the survivor
+        before = shards[0].ingested_rows
+        for i in range(5):
+            plane.feed(_tr(500 + i))
+        assert shards[0].ingested_rows == before + 5
+
+    def test_actor_slot_routing_is_stable(self):
+        plane, shards, reg = _plane(2, 32)
+        # a fixed actor slot always lands on the same shard
+        for i in range(6):
+            plane.feed(_tr(i, actor=4))
+        assert {shards[0].ingested_rows, shards[1].ingested_rows} \
+            == {0, 6}
+
+
+# ---------------------------------------------------------------------------
+# codecs + config plane
+# ---------------------------------------------------------------------------
+
+class TestCodecs:
+    def test_ssample_roundtrip(self):
+        sid, gen, values = _unpack_ssample(_pack_ssample(3, 17))
+        assert (sid, gen, len(values)) == (3, 17, 0)
+        vals = np.array([0.5, 1.25], np.float64)
+        sid, gen, out = _unpack_ssample(_pack_ssample(1, 2, vals))
+        np.testing.assert_array_equal(out, vals)
+
+    def test_ssample_reply_roundtrip_via_local_shard(self):
+        plane, shards, reg = _plane(1, 16)
+        for i in range(6):
+            plane.feed(_tr(i, actor=i))
+        total = shards[0].per.sum_tree.total
+        reply = _unpack_ssample_reply(shards[0].handle_ssample(
+            _pack_ssample(0, shards[0].generation,
+                          np.array([total * 0.1, total * 0.9]))))
+        assert reply["status"] == SSTAT_OK
+        assert reply["mass"]["size"] == 6
+        assert reply["mass"]["ingested"] == 6
+        rows = reply["rows"]
+        assert rows["idx"].shape == (2,)
+        for f in REPLAY_FIELDS:
+            assert rows[f].shape[0] == 2
+        # dead shard answers SSTAT_DEAD, not silence
+        shards[0].alive = False
+        reply = _unpack_ssample_reply(shards[0].handle_ssample(
+            _pack_ssample(0, shards[0].generation)))
+        assert reply["status"] == SSTAT_DEAD
+
+    def test_sprio_dispatch_applies_and_fences(self):
+        plane, shards, reg = _plane(1, 16)
+        for i in range(4):
+            plane.feed(_tr(i))
+        ok = shards[0].handle_sprio(_pack_sprio(
+            0, shards[0].generation, np.array([0, 1], np.int32),
+            np.array([2.0, 3.0], np.float32)))
+        assert ok == {"status": "ok", "rows": 2}
+        stale = shards[0].handle_sprio(_pack_sprio(
+            0, shards[0].generation - 1, np.array([0], np.int32),
+            np.array([9.0], np.float32)))
+        assert stale["status"] == "stale"
+        assert shards[0].stale_rejected == 1
+
+    def test_malformed_frames_raise_connection_error(self):
+        with pytest.raises(ConnectionError):
+            _unpack_ssample(b"not a savez")
+        with pytest.raises(ConnectionError):
+            _unpack_ssample_reply(b"junk")
+        plane, shards, _ = _plane(1, 8)
+        with pytest.raises(ConnectionError):
+            shards[0].handle_sprio(b"junk")
+
+    def test_smass_dispatch(self):
+        reg = ShardRegistry(ShardParams(shards=2, lease_s=30.0))
+        grant = reg.handle_smass({"action": "acquire", "shard": 0,
+                                  "incarnation": 1})
+        assert grant["status"] == "ok"
+        gen = grant["generation"]
+        assert reg.handle_smass({"action": "renew", "shard": 0,
+                                 "generation": gen,
+                                 "report": {"mass": 2.5, "size": 3}}
+                                )["status"] == "ok"
+        st = reg.handle_smass({"action": "status"})
+        assert st["shards"]["members"]["0"]["mass"] == 2.5
+        assert reg.handle_smass({"action": "bogus", "shard": 0}
+                                )["status"] == "error"
+        assert reg.handle_smass({"action": "acquire", "shard": "x"}
+                                )["status"] == "error"
+
+
+class TestConfigPlane:
+    def test_sharding_off_by_default(self):
+        assert sharding_active() is False
+        assert resolve_shard().shards == 0
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("TPU_APEX_SHARD_SHARDS", "4")
+        monkeypatch.setenv("TPU_APEX_SHARD_LEASE_S", "1.5")
+        monkeypatch.setenv("TPU_APEX_SHARD_COORDINATOR", "h:9")
+        sp = resolve_shard()
+        assert (sp.shards, sp.lease_s, sp.coordinator) == (4, 1.5, "h:9")
+        assert sharding_active() is True
+
+    def test_status_block_counters_are_complete(self):
+        plane, shards, reg = _plane(2, 32)
+        sb = reg.status_block()
+        assert set(sb["counters"]) == {
+            "leases_granted", "leases_expired", "leases_released",
+            "lease_fenced", "shard_lost_rows",
+            "stale_writeback_rejected", "route_dropped", "rebalances",
+            "joins_completed", "joins_timed_out"}
+        assert sb["expected"] == 2 and sb["degraded"] is False
+
+
+# ---------------------------------------------------------------------------
+# the wire: gateway dispatch, remote channels, the disabled path
+# ---------------------------------------------------------------------------
+
+def _gateway(shards=None):
+    from pytorch_distributed_tpu.agents.clocks import (
+        ActorStats, GlobalClock,
+    )
+    from pytorch_distributed_tpu.agents.param_store import ParamStore
+    from pytorch_distributed_tpu.parallel.dcn import DcnGateway
+
+    store = ParamStore(4)
+    store.publish(np.zeros(4, np.float32))
+    delivered = []
+    gw = DcnGateway(store, GlobalClock(), ActorStats(),
+                    put_chunk=lambda items: delivered.extend(items),
+                    host="127.0.0.1", port=0, shards=shards)
+    return gw, delivered
+
+
+class TestWire:
+    def test_noshard_status_code_pinned_to_dcn(self):
+        # dcn authors exactly one shard frame (the no-handler reply);
+        # this pin is what lets it avoid importing the plane
+        from pytorch_distributed_tpu.memory.shard_plane import (
+            SSTAT_NOSHARD,
+        )
+        from pytorch_distributed_tpu.parallel.dcn import (
+            _pack_noshard_reply,
+        )
+        rep = _unpack_ssample_reply(_pack_noshard_reply())
+        assert rep["status"] == SSTAT_NOSHARD
+
+    def test_gateway_serves_shard_verbs_via_remote_channel(self):
+        from pytorch_distributed_tpu.memory.shard_plane import (
+            RemoteShardChannel,
+        )
+
+        plane, shards, reg = _plane(1, 16)
+        for i in range(6):
+            plane.feed(_tr(i, actor=i))
+        gw, _ = _gateway(shards=shards[0])
+        try:
+            ch = RemoteShardChannel(("127.0.0.1", gw.port), 0,
+                                    shards[0].generation)
+            rep = ch.poll()
+            assert rep is not None and rep["size"] == 6
+            total = shards[0].per.sum_tree.total
+            rows = ch.sample_rows(np.array([total * 0.2, total * 0.8]))
+            assert rows is not None and rows["idx"].shape == (2,)
+            for f in REPLAY_FIELDS:
+                assert rows[f].shape[0] == 2
+            # fenced write-back over the wire: wrong generation is a
+            # counted reject, right generation applies
+            assert ch.write_prio(rows["idx"], np.array([1.0, 2.0]),
+                                 shards[0].generation - 1) is False
+            assert shards[0].stale_rejected == 2
+            assert ch.write_prio(rows["idx"], np.array([1.0, 2.0]),
+                                 shards[0].generation) is True
+            ch.close()
+        finally:
+            gw.close()
+
+    def test_coordinator_gateway_serves_membership_and_status(self):
+        from pytorch_distributed_tpu.memory.shard_plane import ShardLease
+
+        reg = ShardRegistry(ShardParams(shards=2, lease_s=30.0))
+        gw, _ = _gateway(shards=reg)
+        try:
+            lease = ShardLease(("127.0.0.1", gw.port), 0,
+                               incarnation=1, capacity=8)
+            grant = lease.acquire()
+            assert grant["status"] == "ok" and lease.generation >= 1
+            assert lease.renew({"mass": 1.5, "size": 2,
+                                "ingested": 2}) is True
+            from pytorch_distributed_tpu.parallel.dcn import fetch_status
+            snap = fetch_status(("127.0.0.1", gw.port))
+            assert snap["shards"]["members"]["0"]["ingested"] == 2
+            assert snap["shards"]["degraded"] is True  # 1 of 2 up
+            # the fleet_top panel renders straight off this STATUS
+            import importlib
+            fleet_top = importlib.import_module("tools.fleet_top")
+            line = fleet_top.shards_line(snap) or ""
+            assert line.startswith("  shards: 1/2 DEGRADED"), line
+            assert fleet_top.shards_line({"slots": {}}) is None
+            lease.release()
+            assert reg.leases_released == 1
+        finally:
+            gw.close()
+
+    def test_unsharded_gateway_zero_new_status_fields(self):
+        from pytorch_distributed_tpu.memory.shard_plane import (
+            SSTAT_NOSHARD, RemoteShardChannel,
+        )
+
+        gw, _ = _gateway(shards=None)
+        try:
+            from pytorch_distributed_tpu.parallel.dcn import fetch_status
+            snap = fetch_status(("127.0.0.1", gw.port))
+            assert "shards" not in snap
+            # the verbs still answer (counted errors, never a crash)
+            ch = RemoteShardChannel(("127.0.0.1", gw.port), 0, 1)
+            rep = _unpack_ssample_reply(
+                ch._rpc(__import__("pytorch_distributed_tpu.parallel.dcn",
+                                   fromlist=["T_SSAMPLE"]).T_SSAMPLE,
+                        _pack_ssample(0, 1)))
+            assert rep["status"] == SSTAT_NOSHARD
+            assert ch.poll() is None
+            ch.close()
+        finally:
+            gw.close()
+
+
+class TestFactory:
+    def _opt(self):
+        from pytorch_distributed_tpu.config import build_options
+        return build_options(1, memory_type="prioritized",
+                             env_type="fake")
+
+    def test_disabled_builds_plain_per(self):
+        from pytorch_distributed_tpu.factory import build_memory, probe_env
+        opt = self._opt()
+        handles = build_memory(opt, probe_env(opt))
+        assert isinstance(handles.learner_side.memory, PrioritizedReplay)
+
+    def test_enabled_builds_loopback_plane(self, monkeypatch):
+        from pytorch_distributed_tpu.factory import build_memory, probe_env
+        from pytorch_distributed_tpu.memory.shard_plane import (
+            ShardedReplayPlane,
+        )
+        monkeypatch.setenv("TPU_APEX_SHARD_SHARDS", "2")
+        opt = self._opt()
+        handles = build_memory(opt, probe_env(opt))
+        plane = handles.learner_side.memory
+        assert isinstance(plane, ShardedReplayPlane)
+        assert len(plane.channels) == 2
+        # the QueueOwner boundary is intact: feeder -> drain -> sample
+        # (rows must match the env spec or the validator quarantines)
+        feeder = handles.actor_side
+        for i in range(8):
+            s = np.zeros(plane.state_shape, plane.state_dtype)
+            feeder.feed(Transition(
+                state0=s, action=plane.action_dtype.type(0),
+                reward=np.float32(i), gamma_n=np.float32(0.99),
+                state1=s, terminal1=np.float32(0.0),
+                prov=make_prov(i, 0, 0, i)))
+        feeder.flush()
+        handles.learner_side.drain()
+        assert handles.learner_side.size == 8
+        b = handles.learner_side.sample(4, np.random.default_rng(0))
+        assert b.index.shape == (4,)

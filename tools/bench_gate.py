@@ -83,6 +83,14 @@ SPECS: List[Tuple[str, str, str]] = [
     ("wire.bytes_per_transition", "lower_rel", "wire"),
     ("wire.replica_bytes_per_round", "lower_rel", "wire"),
     ("wire_overhead.wire_overhead_frac", "lower_abs", "overhead"),
+    # ISSUE-20 sharded-replay plane: per-shard-count sample latency
+    # (loopback, so plane arithmetic — regressions are tree/merge
+    # changes, not socket noise) and the mass-refresh+route cost held
+    # inside the overhead band
+    ("shard.sample_ms_1shard", "lower_rel", "shard"),
+    ("shard.sample_ms_2shard", "lower_rel", "shard"),
+    ("shard.sample_ms_4shard", "lower_rel", "shard"),
+    ("shard_overhead.shard_overhead_frac", "lower_abs", "overhead"),
     ("device_env.host_frames_per_sec", "higher", "device_env"),
     ("device_env.device_frames_per_sec", "higher", "device_env"),
     ("device_env.fused_frames_per_sec", "higher", "device_env"),
@@ -119,6 +127,11 @@ DEFAULT_TOL: Dict[str, float] = {
     # byte counts are layout-deterministic; the slack only covers savez
     # header drift across numpy versions
     "wire": 0.10,
+    # loopback sample latency: pure python/numpy tree walks measured
+    # best-of-chunks, but a gate host running the full check.sh chain
+    # is LOADED — a genuine regression (an accidental linear scan in
+    # the two-level walk) blows past 2x, scheduler contention doesn't
+    "shard": 1.00,
 }
 
 

@@ -89,6 +89,20 @@ as EXIT_DISCONNECTED always demanded.  A standby that never promotes
 is an explicit readable "gateway never recovered" violation and a
 nonzero exit — never a hang.  See ``gateway_soak``.
 
+Shard drills (ISSUE 20, the sharded prioritized-replay plane —
+memory/shard_plane.py): ``--kill-shard AT`` (SIGKILL-equivalent crash
+of the highest replay shard mid-ingest; its lease must expire within
+~one window, sampling must continue over the survivors, the
+conservation ledger ``minted = ingested + shard_lost + route_dropped``
+must balance EXACTLY, and the pre-kill batch's write-back must be a
+counted fenced reject), ``--rejoin-shard`` (a fresh host re-leases the
+shard id at a NEW generation through the join barrier; the
+``shard_membership`` alert must resolve and a zombie holding the dead
+generation must be a counted reject at the rejoined shard), and
+``--shard-rebalance`` (graceful release + fresh-incarnation
+re-acquire: the route rebuilds both ways, released rows land counted
+in ``shard_lost``).  See ``shard_soak``.
+
 Usage:
     python tools/chaos_soak.py --seconds 30 --actors 4 --seed 0
     python tools/chaos_soak.py --seconds 60 --restart-every 5
@@ -1273,6 +1287,534 @@ def replica_soak(replicas: int = 2, rounds: int = 30, seed: int = 0,
 
 
 # ---------------------------------------------------------------------------
+# sharded-replay drills (ISSUE 20): kill / rejoin / rebalance a replay
+# shard under live ingest + two-level sampling — the priority plane must
+# degrade to the survivors within one lease window, with an EXACT
+# conservation ledger and a fenced write-back plane
+# ---------------------------------------------------------------------------
+
+# the shard drill's rule set: the membership rule MUST fire while the
+# plane is degraded and resolve once the rejoin/re-acquire activates;
+# the flap rule (same tag, a dwell no drill can sustain) is the
+# quiet-by-construction guard for the unexpected-alert invariant
+SHARD_ALERT_RULES = (
+    "shard_membership: replay/shard_degraded >= 1 for 0.3s; "
+    "shard_flap: replay/shard_degraded >= 1 for 30s")
+
+
+class SyntheticShardHost:
+    """One replay-shard host in-process: a ``LocalShard`` behind its OWN
+    ``DcnGateway`` (T_EXP ingest + the shard verbs on the real wire),
+    lease-renewing against the coordinator gateway —
+    ``fleet.run_replay_shard_host`` without the process boundary, so the
+    drill can kill it at an exact quiescent instant and read its trees
+    directly for the sampling-mass-vs-survivor-mass verdict."""
+
+    def __init__(self, coordinator, sid: int, shard_capacity: int,
+                 lease_s: float, incarnation: int = 1):
+        from pytorch_distributed_tpu.memory.shard_plane import (
+            LocalShard, ShardLease,
+        )
+
+        self.sid = int(sid)
+        self.shard_capacity = int(shard_capacity)
+        self.shard = LocalShard(sid, self._fresh_per())
+        self.lease = ShardLease(coordinator, sid,
+                                incarnation=incarnation,
+                                capacity=shard_capacity)
+        self.lease.acquire()
+        self.shard.generation = int(self.lease.generation)
+        self.lease_s = float(lease_s)
+        self._stop = threading.Event()
+        self.clock = GlobalClock()
+        self.gw = DcnGateway(ParamStore(4), self.clock, ActorStats(),
+                             put_chunk=self._ingest, host="127.0.0.1",
+                             port=0, idle_deadline=30.0,
+                             shards=self.shard)
+        self.addr = ("127.0.0.1", self.gw.port)
+        self._renewer = threading.Thread(
+            target=self._renew_loop, name=f"shard-host-{sid}",
+            daemon=True)
+        self._renewer.start()
+
+    def _fresh_per(self):
+        from pytorch_distributed_tpu.memory.prioritized import (
+            PrioritizedReplay,
+        )
+
+        return PrioritizedReplay(
+            capacity=self.shard_capacity, state_shape=(2,),
+            state_dtype=np.float32, action_shape=(),
+            action_dtype=np.int32, priority_exponent=0.6,
+            importance_weight=0.4, importance_anneal_steps=1000)
+
+    def _report(self) -> dict:
+        m = self.shard.mass()
+        m["mass"] = m["total"]
+        m["fill"] = m["size"] / max(1, self.shard.per.capacity)
+        return m
+
+    def _ingest(self, items: list) -> None:
+        for t, p in items:
+            self.shard.feed(t, p)
+        if not self.shard.alive:
+            return
+        if self.lease.joining and self.shard.ingested_rows > 0:
+            self.lease.activate()
+        # renew BEFORE the gateway acks the chunk (the T_CLOCK ack goes
+        # out after put_chunk returns): every row the plane counts as
+        # delivered is already in the registry's ingested leg — the
+        # conservation ledger is exact at the kill instant, not
+        # eventually
+        self.lease.renew(self._report())
+
+    def _renew_loop(self) -> None:
+        period = max(0.05, self.lease_s / 3.0)
+        while not self._stop.wait(period):
+            if self.shard.alive:
+                try:
+                    self.lease.renew(self._report())
+                except (ConnectionError, OSError):
+                    pass
+
+    def final_renew(self) -> None:
+        """Push the definitive ingest report before a verdict read."""
+        if self.shard.alive:
+            self.lease.renew(self._report())
+
+    def rebalance_reacquire(self) -> None:
+        """The --shard-rebalance leg: after a graceful release, take the
+        slot back as a FRESH incarnation — empty ring, zeroed ledger
+        legs (the released rows were counted ``shard_lost``; serving
+        them again would double-count) — through the join barrier."""
+        self.shard.per = self._fresh_per()
+        self.shard.ingested_rows = 0
+        self.shard.stale_rejected = 0
+        self.lease.incarnation += 1
+        self.lease.acquire()
+        self.shard.generation = int(self.lease.generation)
+
+    def kill(self) -> None:
+        """SIGKILL-equivalent: the shard answers nothing, renews
+        nothing, and its lease expires on the coordinator."""
+        self.shard.alive = False
+        self._stop.set()
+        self.clock.stop.set()
+        self.gw.close()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self.shard.alive:
+            self.lease.release()
+        self.clock.stop.set()
+        self.gw.close()
+
+
+def shard_soak(shards: int = 3, seconds: float = 8.0, seed: int = 0,
+               kill_at: Optional[float] = None, rejoin: bool = False,
+               rebalance: bool = False, lease_s: float = 0.5,
+               batch: int = 32, log_dir: Optional[str] = None,
+               port: int = 0, verbose: bool = True) -> dict:
+    """The ISSUE-20 shard-loss degradation drill: N synthetic shard
+    hosts serve one fault-fenced priority plane through REAL gateways
+    (T_EXP ingest, T_SSAMPLE two-level sampling, T_SPRIO write-back,
+    T_SMASS leases) while actors mint and a learner-side sampler draws
+    and writes back continuously.  Verdict failures:
+
+    - **deadlock** — any actor/sampler thread alive at the join
+      deadline, or the plane never reaching steady sampling;
+    - **conservation breached** — the ledger ``minted = ingested +
+      shard_lost + route_dropped`` must balance EXACTLY (every row a
+      dead shard took down is COUNTED, never silently resampled away);
+    - **fencing too slow / never fenced** — the killed shard must leave
+      membership within ~one lease window;
+    - **sampling stalled** — the survivors must keep serving batches
+      through the degraded window;
+    - **mass divergence** — the plane's sampling-mass vector must equal
+      the survivors' exact ``sum_tree.total`` floats;
+    - **unfenced stale write-back** — a batch sampled before the kill
+      must have its dead-shard rows counted as rejects on write-back
+      (plane side), and a zombie writer holding the dead generation
+      must be a counted reject at the rejoined shard (host side);
+    - **expected-alert-never-fired / any-unexpected-alert /
+      unresolved** — the ``shard_membership`` alert must fire during
+      the degraded window, resolve after the rejoin/re-acquire, and
+      nothing else may fire."""
+    from pytorch_distributed_tpu.config import (
+        AlertParams, MetricsParams, ShardParams,
+    )
+    from pytorch_distributed_tpu.memory.shard_plane import (
+        RemoteShardChannel, ShardedReplayPlane, ShardRegistry,
+    )
+    from pytorch_distributed_tpu.utils import flight_recorder, telemetry
+    from pytorch_distributed_tpu.utils.experience import make_prov
+    from pytorch_distributed_tpu.utils.metrics import MetricsWriter
+
+    violations: List[str] = []
+    if log_dir:
+        flight_recorder.configure(log_dir, run_id="chaos-soak")
+    mission = telemetry.MissionControl(
+        log_dir, MetricsParams(enabled=True, poll_s=0.1),
+        AlertParams(rules=SHARD_ALERT_RULES))
+    mission.start()
+    if log_dir:
+        reg_writer = MetricsWriter(log_dir, enable_tensorboard=False,
+                                   role="gateway", run_id="chaos-soak")
+    else:
+        reg_writer = _AggregatorWriter(mission.metrics)
+
+    registry = ShardRegistry(
+        ShardParams(shards=shards, lease_s=lease_s,
+                    join_timeout_s=15.0),
+        writer=reg_writer)
+    clock = GlobalClock()
+    gw = DcnGateway(ParamStore(4), clock, ActorStats(),
+                    put_chunk=lambda items: None, host="127.0.0.1",
+                    port=port, idle_deadline=30.0,
+                    health=lambda: mission.status_block(),
+                    shards=registry)
+    addr = ("127.0.0.1", gw.port)
+
+    cap = 512
+    hosts: Dict[int, SyntheticShardHost] = {
+        sid: SyntheticShardHost(addr, sid, cap, lease_s)
+        for sid in range(shards)}
+    channels = {sid: RemoteShardChannel(h.addr, sid,
+                                        h.lease.generation)
+                for sid, h in hosts.items()}
+    plane = ShardedReplayPlane(
+        channels, registry, cap, state_shape=(2,),
+        state_dtype=np.float32, action_dtype=np.int32,
+        importance_weight=0.4, importance_anneal_steps=1000)
+
+    # ONE learner: every plane op (routed feed, two-level sample,
+    # write-back, the kill itself) serializes on this lock — which is
+    # what makes the kill land at a QUIESCENT instant, so the
+    # conservation ledger must balance exactly, not modulo a race
+    plane_lock = threading.Lock()
+    stop = threading.Event()
+    # one actor per shard plus one: slot-stable routing (prov[0] picks
+    # the shard) must leave NO shard coverage-starved — including the
+    # rejoiner, whose activation rides its first routed row
+    actors = shards + 1
+    minted = [0] * actors
+    sampled = [0]
+
+    def actor_loop(aid: int) -> None:
+        step = 0
+        while not stop.is_set():
+            t = tagged_transition(aid * 1_000_000 + step)
+            t = t._replace(prov=make_prov(aid, 0, 0, step))
+            with plane_lock:
+                plane.feed(t, None)
+                minted[aid] += 1
+            step += 1
+            time.sleep(0.004)
+
+    rng = np.random.default_rng(seed)
+
+    def sampler_loop() -> None:
+        while not stop.is_set():
+            with plane_lock:
+                plane._refresh_mass(force=True)
+                if plane._mass and sum(
+                        e["size"] for e in plane._mass) >= batch:
+                    b = plane.sample(batch, rng)
+                    plane.update_priorities(
+                        b.index, np.abs(b.reward) * 1e-7 + 0.5)
+                    sampled[0] += 1
+            time.sleep(0.004)
+
+    threads = [threading.Thread(target=actor_loop, args=(aid,),
+                                name=f"shard-actor-{aid}", daemon=True)
+               for aid in range(actors)]
+    threads.append(threading.Thread(target=sampler_loop,
+                                    name="shard-sampler", daemon=True))
+    t0 = time.monotonic()
+    for th in threads:
+        th.start()
+
+    victim = shards - 1
+    stale_expected = 0
+    joiner: Optional[SyntheticShardHost] = None
+    fence_s = None
+    dead_generation = None
+    try:
+        # ---- warm-up: the plane must actually be sampling ---------------
+        while time.monotonic() - t0 < 15.0 and sampled[0] < 3:
+            time.sleep(0.02)
+        if sampled[0] < 3:
+            violations.append("deadlock: the plane never reached "
+                              "steady sampling during warm-up")
+
+        if kill_at is not None and not violations:
+            while time.monotonic() - t0 < kill_at:
+                time.sleep(0.01)
+            # ---- the kill, at a quiescent instant -----------------------
+            with plane_lock:
+                # draw the soon-to-be-stale batch FIRST: its dead-shard
+                # rows are the unfenced-stale-write probe
+                plane._refresh_mass(force=True)
+                stale_batch = plane.sample(batch * 2, rng)
+                stale_victim_rows = int(
+                    (stale_batch.index // cap == victim).sum())
+                dead_generation = hosts[victim].shard.generation
+                hosts[victim].kill()
+            if stale_victim_rows == 0:
+                violations.append(
+                    "drill impotent: the pre-kill batch drew no "
+                    "victim rows (nothing to test fencing with)")
+            t_kill = time.monotonic()
+            # ---- fencing: within ~one lease window ----------------------
+            while time.monotonic() - t_kill < lease_s * 4 + 2.0:
+                if registry.status_block()["degraded"]:
+                    break
+                time.sleep(0.01)
+            fence_s = time.monotonic() - t_kill
+            if not registry.status_block()["degraded"]:
+                violations.append(
+                    f"shard loss never fenced (no degradation after "
+                    f"{fence_s:.1f}s; lease window {lease_s}s)")
+            elif fence_s > lease_s * 2.0 + 0.5:
+                violations.append(
+                    f"fencing too slow: {fence_s:.2f}s > one lease "
+                    f"window ({lease_s}s) + slop")
+            # ---- sampling must CONTINUE over the survivors --------------
+            s_before = sampled[0]
+            t_chk = time.monotonic()
+            while time.monotonic() - t_chk < 5.0 \
+                    and sampled[0] < s_before + 5:
+                time.sleep(0.02)
+            if sampled[0] < s_before + 5:
+                violations.append("sampling stalled after the shard "
+                                  "loss (survivors must keep serving)")
+            # ---- mass vector == the survivors' EXACT tree totals --------
+            with plane_lock:
+                plane._refresh_mass(force=True)
+                got = {e["shard"]: float(e["total"])
+                       for e in plane._mass}
+                want = {sid: float(h.shard.per.sum_tree.total)
+                        for sid, h in hosts.items() if h.shard.alive}
+                if got != want:
+                    violations.append(
+                        f"sampling mass diverged from survivor mass: "
+                        f"plane={got} survivors={want}")
+                # ---- the stale write-back: counted, never applied -------
+                before = registry.stale_writeback_rejected
+                plane.update_priorities(
+                    stale_batch.index,
+                    np.full(len(stale_batch.index), 9.9, np.float32))
+                counted = registry.stale_writeback_rejected - before
+                if counted != stale_victim_rows:
+                    violations.append(
+                        f"unfenced stale write-back: "
+                        f"{stale_victim_rows} dead-shard rows in the "
+                        f"batch, {counted} counted rejects")
+            stale_expected = stale_victim_rows
+
+        if rejoin and kill_at is not None:
+            time.sleep(1.0)  # the 0.3s-dwell membership alert fires
+            joiner = SyntheticShardHost(addr, victim, cap, lease_s,
+                                        incarnation=2)
+            if not joiner.lease.joining:
+                violations.append("rejoin skipped the join barrier "
+                                  "(fresh lease was not 'joining')")
+            with plane_lock:
+                channels[victim] = RemoteShardChannel(
+                    joiner.addr, victim, joiner.lease.generation)
+                plane.attach_channel(victim, channels[victim])
+            # routed ingest warms it; the first acked row activates it.
+            # degraded flips False the moment the lease is GRANTED (the
+            # joiner counts as a member while JOINING), so waiting on
+            # degraded alone is a no-op — wait for the activation proper
+            t_j = time.monotonic()
+            while time.monotonic() - t_j < 10.0 and \
+                    (registry.joins_completed < 1
+                     or registry.status_block()["degraded"]):
+                time.sleep(0.02)
+            if registry.status_block()["degraded"]:
+                violations.append("membership never recovered after "
+                                  "the rejoin")
+            if registry.joins_completed < 1:
+                violations.append("rejoiner never activated (no routed "
+                                  "ingest reached it before the join "
+                                  "deadline)")
+            # ---- zombie leg: the dead generation fences at the
+            # REJOINED shard (host-side counted reject) ------------------
+            zc = RemoteShardChannel(joiner.addr, victim,
+                                    dead_generation)
+            if zc.write_prio(np.asarray([0], np.int64),
+                             np.asarray([9.9], np.float32),
+                             dead_generation) is not False:
+                violations.append(
+                    "unfenced stale write: the zombie's dead-"
+                    "generation write-back was accepted at the "
+                    "rejoined shard")
+            if joiner.shard.stale_rejected != 1:
+                violations.append(
+                    f"zombie write not counted at the shard "
+                    f"(stale_rejected={joiner.shard.stale_rejected})")
+            zc.close()
+
+        if rebalance:
+            rb_sid = 0  # a live shard distinct from the kill victim
+            rb = hosts[rb_sid]
+            with plane_lock:
+                rb.final_renew()  # the definitive count before the move
+                rb.lease.release()
+            t_rb = time.monotonic()
+            while time.monotonic() - t_rb < 5.0 and \
+                    not registry.status_block()["degraded"]:
+                time.sleep(0.01)
+            if not registry.status_block()["degraded"]:
+                violations.append("graceful release never degraded "
+                                  "membership (rebalance drill)")
+            time.sleep(1.0)  # alert dwell: fire during the gap
+            jc0 = registry.joins_completed
+            with plane_lock:
+                rb.rebalance_reacquire()
+            if not rb.lease.joining:
+                violations.append("rebalance re-acquire skipped the "
+                                  "join barrier")
+            # as in the rejoin leg: degraded clears at the GRANT, so
+            # wait for the activation itself (first routed ingest acked)
+            t_rj = time.monotonic()
+            while time.monotonic() - t_rj < 10.0 and \
+                    (registry.joins_completed <= jc0
+                     or registry.status_block()["degraded"]):
+                time.sleep(0.02)
+            if registry.status_block()["degraded"]:
+                violations.append("membership never recovered after "
+                                  "the rebalance re-acquire")
+            if registry.joins_completed <= jc0:
+                violations.append("rebalanced shard never re-activated "
+                                  "(no routed ingest reached it before "
+                                  "the join deadline)")
+
+        # ---- alert verdict, polled while membership still holds ---------
+        recovered = (rejoin and kill_at is not None) or rebalance
+        if recovered:
+            end = time.monotonic() + 5.0
+            while time.monotonic() < end:
+                mission.poll()
+                snap = {a["rule"]: a for a in mission.engine.snapshot()}
+                dg = snap.get("shard_membership", {})
+                if dg.get("fired_total", 0) > 0 \
+                        and dg.get("state") not in ("pending",
+                                                    "firing"):
+                    break
+                time.sleep(mission.params.poll_s)
+        else:
+            time.sleep(3 * mission.params.poll_s + 0.2)
+        mission.poll()
+        alert_snap = mission.engine.snapshot()
+
+        # ---- stop the load; read the ledger at a quiescent point --------
+        stop.set()
+        for th in threads:
+            th.join(10.0)
+            if th.is_alive():
+                violations.append(f"deadlock: {th.name} still running "
+                                  f"at the join deadline")
+        with plane_lock:
+            for h in list(hosts.values()) \
+                    + ([joiner] if joiner is not None else []):
+                if h.shard.alive:
+                    h.final_renew()
+            led = registry.ledger()
+            counters = dict(registry.status_block()["counters"])
+        minted_total = sum(minted)
+        accounted = (led["ingested"] + led["shard_lost"]
+                     + led["route_dropped"])
+        if minted_total != accounted:
+            violations.append(
+                f"conservation breached: minted {minted_total} != "
+                f"ingested {led['ingested']} + shard_lost "
+                f"{led['shard_lost']} + route_dropped "
+                f"{led['route_dropped']} = {accounted}")
+
+        # ---- exact-counter verdict --------------------------------------
+        expected_granted = shards \
+            + (1 if joiner is not None else 0) \
+            + (1 if rebalance else 0)
+        checks = [
+            ("leases_granted", expected_granted),
+            ("leases_expired", 1 if kill_at is not None else 0),
+            ("leases_released", 1 if rebalance else 0),
+            ("lease_fenced", 0),
+            ("joins_timed_out", 0),
+            ("joins_completed",
+             (1 if joiner is not None else 0)
+             + (1 if rebalance else 0)),
+            ("stale_writeback_rejected", stale_expected),
+        ]
+        for name, want in checks:
+            if counters.get(name) != want:
+                violations.append(f"ledger mismatch: {name} = "
+                                  f"{counters.get(name)} "
+                                  f"(expected {want})")
+
+        # ---- alert verdict ----------------------------------------------
+        fired = sorted(a["rule"] for a in alert_snap
+                       if a["fired_total"] > 0)
+        unresolved = sorted(a["rule"] for a in alert_snap
+                            if a["state"] in ("pending", "firing"))
+        expected_alerts = (["shard_membership"]
+                           if (kill_at is not None or rebalance)
+                           else [])
+        unexpected = [r for r in fired if r not in expected_alerts]
+        if unexpected:
+            violations.append(f"unexpected alert(s) fired: "
+                              f"{unexpected}")
+        for r in expected_alerts:
+            if r not in fired:
+                violations.append(f"expected alert {r!r} never fired "
+                                  f"during the degraded window")
+        if recovered and unresolved:
+            violations.append(f"alert(s) {unresolved} still unresolved "
+                              f"after membership recovered")
+    finally:
+        stop.set()
+        for h in list(hosts.values()) \
+                + ([joiner] if joiner is not None else []):
+            try:
+                h.shutdown()
+            except (ConnectionError, OSError):
+                pass
+        for ch in channels.values():
+            ch.close()
+        clock.stop.set()
+        mission.stop()
+        gw.close()
+
+    report = {
+        "violations": violations,
+        "shards": shards,
+        "kill_at": kill_at,
+        "rejoin": rejoin,
+        "rebalance": rebalance,
+        "minted": sum(minted),
+        "sampled_batches": sampled[0],
+        "fence_s": round(fence_s, 3) if fence_s is not None else None,
+        "ledger": led,
+        "counters": counters,
+        "alerts": {"fired": fired, "unexpected": unexpected,
+                   "unresolved": unresolved},
+        "port": addr[1],
+    }
+    if log_dir:
+        reg_writer.close()
+        flight_recorder.dump_all("shard chaos drill complete")
+    if verbose:
+        for k, v in report.items():
+            if k != "violations":
+                print(f"[chaos] {k}: {v}")
+        for v in violations:
+            print(f"[chaos] VIOLATION: {v}")
+    return report
+
+
+# ---------------------------------------------------------------------------
 # gateway high-availability drills (ISSUE 16): kill the primary under a
 # live fleet — warm standby must promote (fenced), clients must fail
 # over, and the ledger must stay EXACT across the cutover
@@ -1779,6 +2321,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="replica-drill fleet size")
     ap.add_argument("--replica-rounds", type=int, default=30,
                     help="rounds each surviving replica must complete")
+    ap.add_argument("--kill-shard", type=float, default=None,
+                    metavar="AT",
+                    help="shard drill (ISSUE 20): SIGKILL-equivalent "
+                         "crash of the highest replay shard AT seconds "
+                         "into the run, mid-ingest — its lease must "
+                         "expire within ~one window, sampling must "
+                         "continue over the survivors with an EXACT "
+                         "conservation ledger (lost rows COUNTED), the "
+                         "pre-kill batch's write-back must be a counted "
+                         "fenced reject, and the shard_membership "
+                         "alert must fire")
+    ap.add_argument("--rejoin-shard", action="store_true",
+                    help="shard drill: after the kill, a fresh host "
+                         "re-leases the shard id at a NEW generation "
+                         "through the join barrier — membership must "
+                         "recover, the alert must resolve, and the "
+                         "zombie's dead-generation write-back must be "
+                         "a counted reject at the rejoined shard")
+    ap.add_argument("--shard-rebalance", action="store_true",
+                    help="shard drill: gracefully release one live "
+                         "shard mid-run and re-acquire it as a fresh "
+                         "incarnation — the route must rebuild both "
+                         "ways, released rows land in shard_lost "
+                         "(counted), and the membership alert must "
+                         "fire during the gap and resolve after")
+    ap.add_argument("--shards", type=int, default=3,
+                    help="shard-drill plane width")
+    ap.add_argument("--shard-lease", type=float, default=0.5,
+                    metavar="SECS",
+                    help="shard drill lease window (fencing deadline "
+                         "after renew silence)")
     ap.add_argument("--log-dir", type=str, default=None,
                     help="leave the production artifact set (blackbox "
                          "rings with alert transitions, alert/* "
@@ -1797,6 +2370,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             log_dir=args.log_dir, port=args.port)
         ok = not report["violations"]
         print(f"[chaos] {'OK' if ok else 'FAILED'} gateway drill: "
+              f"{len(report['violations'])} violations")
+        return 0 if ok else 1
+    if args.kill_shard is not None or args.rejoin_shard \
+            or args.shard_rebalance:
+        kill_at = args.kill_shard
+        if kill_at is None and args.rejoin_shard:
+            kill_at = 1.5  # bare --rejoin-shard: kill-then-rejoin drill
+        report = shard_soak(
+            shards=args.shards, seconds=args.seconds, seed=args.seed,
+            kill_at=kill_at, rejoin=args.rejoin_shard,
+            rebalance=args.shard_rebalance, lease_s=args.shard_lease,
+            log_dir=args.log_dir, port=args.port)
+        ok = not report["violations"]
+        print(f"[chaos] {'OK' if ok else 'FAILED'} shard drill: "
               f"{len(report['violations'])} violations")
         return 0 if ok else 1
     if args.kill_replica is not None or args.hang_replica is not None \

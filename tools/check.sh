@@ -67,6 +67,19 @@ if ! JAX_PLATFORMS=cpu python tools/chaos_soak.py \
     exit 1
 fi
 
+echo "== stage 1d: shard-loss degradation drill (ISSUE 20) =="
+# the fast replay-shard drill: kill one shard of a live 3-shard
+# priority plane — the lease must fence within one window, sampling
+# must continue on the survivors, the row ledger must stay EXACT
+# (minted == ingested + shard_lost + route_dropped), the dead
+# generation's write-backs must be rejected, and the rejoined shard
+# must pass the join barrier.  Seconds-scale, no jax.
+if ! JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+        --seconds 6 --kill-shard 1.5 --rejoin-shard --shard-lease 0.5; then
+    echo "shard-loss drill: FAIL"
+    exit 1
+fi
+
 echo "== stage 2: bench --smoke =="
 # covers the fused learner program, the ISSUE-7 device-env engine AND
 # the ISSUE-12 anakin closed-loop pair rate (smoke.anakin_frames_per_sec
@@ -147,6 +160,27 @@ print(f"wire_overhead.wire_overhead_frac = {f}")
 EOF
 then
     echo "wire smoke keys: FAIL"
+    exit 1
+fi
+
+echo "== stage 2f: shard smoke keys (ISSUE 20) =="
+# the sharded-replay plane: per-shard-count sample latency must be
+# present and positive, and the sharding overhead fraction must be
+# present and sane (stage 3 then holds it under the 0.02 band)
+if ! python - "$tmp/smoke.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+v = d.get("shard", {}).get("sample_ms_1shard")
+assert isinstance(v, (int, float)) and v > 0, \
+    f"shard.sample_ms_1shard missing/invalid: {v!r}"
+print(f"shard.sample_ms_1shard = {v}")
+f = d.get("shard_overhead", {}).get("shard_overhead_frac")
+assert isinstance(f, (int, float)) and 0 <= f, \
+    f"shard_overhead.shard_overhead_frac missing/invalid: {f!r}"
+print(f"shard_overhead.shard_overhead_frac = {f}")
+EOF
+then
+    echo "shard smoke keys: FAIL"
     exit 1
 fi
 

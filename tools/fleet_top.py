@@ -312,6 +312,45 @@ def replicas_line(status: dict) -> Optional[str]:
     return "  replicas: " + " · ".join(bits)
 
 
+def shards_line(status: dict) -> Optional[str]:
+    """One panel line for the ISSUE-20 sharded replay plane: the STATUS
+    ``shards`` block (coordinator ShardRegistry.status_block) — live
+    member count vs configured with DEGRADED loud, total priority mass
+    + skew, per-shard fill / mass share / rejected-stale ledger, and
+    the degradation counters (rows lost to dead shards are COUNTED,
+    never silent)."""
+    s = status.get("shards")
+    if not s:
+        return None
+    members = s.get("members") or {}
+    expected = s.get("expected", len(members))
+    head = f"{len(members)}/{expected}"
+    if s.get("degraded"):
+        head += " DEGRADED"
+    bits = [head,
+            f"mass {s.get('mass_total', 0.0):g} "
+            f"(skew {s.get('mass_skew', 0.0):g})"]
+    for sid, m in sorted(members.items(), key=lambda kv: int(kv[0])):
+        piece = (f"s{sid} gen{m.get('generation')} "
+                 f"fill {m.get('fill', 0.0):.0%} "
+                 f"share {m.get('mass_share', 0.0):.0%}")
+        if m.get("stale_rejected"):
+            piece += f" stale {m['stale_rejected']}"
+        if m.get("joining"):
+            piece += " JOINING"
+        bits.append(piece)
+    c = s.get("counters") or {}
+    if c.get("shard_lost_rows") or c.get("leases_expired") \
+            or c.get("stale_writeback_rejected") \
+            or c.get("route_dropped"):
+        bits.append(f"lost {c.get('shard_lost_rows', 0)} rows · "
+                    f"expired {c.get('leases_expired', 0)} · "
+                    f"fenced writes "
+                    f"{c.get('stale_writeback_rejected', 0)} · "
+                    f"route-dropped {c.get('route_dropped', 0)}")
+    return "  shards: " + " · ".join(bits)
+
+
 def gateway_line(status: dict) -> Optional[str]:
     """One panel line for the ISSUE-16 gateway HA plane: the STATUS
     ``gateway`` block (only present on HA-enabled fleets) — role and
@@ -468,6 +507,9 @@ def render(status: dict,
     rline = replicas_line(status)
     if rline:
         lines.append(rline)
+    sline = shards_line(status)
+    if sline:
+        lines.append(sline)
     gline = gateway_line(status)
     if gline:
         lines.append(gline)
@@ -608,6 +650,37 @@ def selftest() -> int:
         assert "standby" in gl and "term 3" in gl and "lag" in gl, \
             f"gateway panel line did not render: {gl!r}"
         json.dumps(ha)  # the --json gateway block stays serializable
+        # sharded replay panel (ISSUE 20): absent on an unsharded
+        # fleet (same byte-compat contract), rendered from the block
+        # a sharded coordinator would publish
+        assert "shards" not in status, \
+            "unsharded STATUS leaked a 'shards' block"
+        assert shards_line(status) is None
+        sh = dict(status, shards={
+            "expected": 3, "degraded": True, "generation": 4,
+            "mass_total": 12.5, "mass_skew": 0.4,
+            "members": {
+                "0": {"generation": 2, "lease_age": 0.1,
+                      "joining": False, "fill": 0.5, "size": 512,
+                      "mass": 8.0, "mass_share": 0.64,
+                      "ingested": 512, "stale_rejected": 3,
+                      "renews": 9, "endpoint": ""},
+                "2": {"generation": 4, "lease_age": 0.0,
+                      "joining": True, "fill": 0.0, "size": 0,
+                      "mass": 0.0, "mass_share": 0.0, "ingested": 0,
+                      "stale_rejected": 0, "renews": 1,
+                      "endpoint": ""}},
+            "counters": {"leases_granted": 4, "leases_expired": 1,
+                         "leases_released": 0, "lease_fenced": 0,
+                         "shard_lost_rows": 256,
+                         "stale_writeback_rejected": 3,
+                         "route_dropped": 2, "rebalances": 1,
+                         "joins_completed": 0, "joins_timed_out": 0}})
+        shl = shards_line(sh) or ""
+        assert "2/3 DEGRADED" in shl and "JOINING" in shl \
+            and "lost 256 rows" in shl, \
+            f"shards panel line did not render: {shl!r}"
+        json.dumps(sh)  # the --json shards block stays serializable
     except AssertionError as e:
         print(f"fleet_top --selftest: FAIL: {e}", file=sys.stderr)
         return 1
